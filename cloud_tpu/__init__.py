@@ -1,0 +1,56 @@
+"""cloud-tpu: a TPU-native launch-and-scale framework built on JAX/XLA.
+
+One ``run()`` call takes a local training script or notebook, validates a
+declarative TPU slice config, plans a ``jax.sharding.Mesh`` parallelism
+layout, containerizes the code, and launches it on Cloud TPU — plus a
+Vizier-backed hyperparameter tuner, an in-memory remote-fit path, and a
+native metrics exporter.
+
+Public surface parity with the reference package root
+(``tensorflow_cloud/__init__.py:17-27``): run, remote, MachineConfig,
+AcceleratorType, COMMON_MACHINE_CONFIGS, CloudTuner, CloudOracle, cloud_fit.
+"""
+
+from cloud_tpu.version import __version__
+
+from cloud_tpu.core.machine_config import (
+    AcceleratorType,
+    COMMON_MACHINE_CONFIGS,
+    MachineConfig,
+    TpuTopology,
+    TPU_SLICE_CATALOG,
+    is_tpu_config,
+)
+
+__all__ = [
+    "__version__",
+    "AcceleratorType",
+    "COMMON_MACHINE_CONFIGS",
+    "MachineConfig",
+    "TpuTopology",
+    "TPU_SLICE_CATALOG",
+    "is_tpu_config",
+]
+
+
+def __getattr__(name):
+    # Lazy re-exports: keep `import cloud_tpu` light (no jax/tuner import cost
+    # until used).  Mirrors the reference's flat package-root API.
+    try:
+        if name in ("run", "remote", "RunReport"):
+            from cloud_tpu.core import run as _run
+
+            return getattr(_run, name)
+        if name in ("CloudTuner", "CloudOracle"):
+            from cloud_tpu import tuner as _tuner
+
+            return getattr(_tuner, name)
+        if name == "cloud_fit":
+            from cloud_tpu.cloud_fit import client as _client
+
+            return _client.cloud_fit
+    except ImportError as e:
+        raise AttributeError(
+            f"cloud_tpu.{name} is unavailable: {e}"
+        ) from e
+    raise AttributeError(f"module 'cloud_tpu' has no attribute {name!r}")

@@ -1,0 +1,98 @@
+"""HTTP plumbing for GCP REST calls, with usage telemetry and injectable auth.
+
+Reference analogue: ``utils/google_api_client.py:21-39`` (TFCloudHttpRequest
+stamps ``user-agent: tf-cloud/<ver>`` on every googleapiclient call).  The
+googleapiclient stack is replaced by a thin :mod:`requests` session; every
+network seam in this framework accepts a session-like object so tests inject
+fakes (SURVEY.md §4 takeaway (b)).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from cloud_tpu.version import __version__
+
+USER_AGENT = f"cloud-tpu/{__version__}"
+
+
+class ApiError(RuntimeError):
+    """Non-2xx response from a GCP API."""
+
+    def __init__(self, status: int, message: str, body: Optional[dict] = None):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.body = body or {}
+
+
+class GcpApiSession:
+    """Minimal authenticated JSON-over-REST session.
+
+    ``credentials`` anything with a ``token`` attribute and a
+    ``refresh(request)`` method (google.auth credentials), or None for
+    anonymous (tests).  The object is deliberately tiny so fakes are trivial.
+    """
+
+    def __init__(self, credentials=None, requests_session=None):
+        self._credentials = credentials
+        if requests_session is None:
+            import requests
+
+            requests_session = requests.Session()
+        self._session = requests_session
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {"user-agent": USER_AGENT, "content-type": "application/json"}
+        if self._credentials is not None:
+            if not getattr(self._credentials, "valid", False):
+                import google.auth.transport.requests
+
+                self._credentials.refresh(
+                    google.auth.transport.requests.Request(session=self._session)
+                )
+            headers["authorization"] = f"Bearer {self._credentials.token}"
+        return headers
+
+    def request(
+        self,
+        method: str,
+        url: str,
+        body: Optional[Dict[str, Any]] = None,
+        params: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, Any]:
+        resp = self._session.request(
+            method,
+            url,
+            headers=self._headers(),
+            params=params,
+            data=None if body is None else json.dumps(body),
+        )
+        if resp.status_code >= 300:
+            try:
+                parsed = resp.json()
+            except Exception:
+                parsed = {}
+            raise ApiError(resp.status_code, resp.text[:500], parsed)
+        if not resp.content:
+            return {}
+        return resp.json()
+
+    def get(self, url, params=None):
+        return self.request("GET", url, params=params)
+
+    def post(self, url, body=None, params=None):
+        return self.request("POST", url, body=body, params=params)
+
+    def delete(self, url):
+        return self.request("DELETE", url)
+
+
+def default_session() -> GcpApiSession:
+    """Session with application-default credentials."""
+    import google.auth
+
+    credentials, _ = google.auth.default(
+        scopes=["https://www.googleapis.com/auth/cloud-platform"]
+    )
+    return GcpApiSession(credentials=credentials)

@@ -1,0 +1,23 @@
+"""Runtime/version probes. Reference analogue: utils/tf_utils.py:19-20."""
+
+from __future__ import annotations
+
+
+def get_jax_version() -> str:
+    import jax
+
+    return jax.__version__
+
+
+def get_backend() -> str:
+    """'tpu', 'cpu', or 'gpu' for the default JAX backend."""
+    import jax
+
+    return jax.default_backend()
+
+
+def device_kind() -> str:
+    import jax
+
+    devices = jax.devices()
+    return devices[0].device_kind if devices else "none"

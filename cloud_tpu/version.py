@@ -1,0 +1,6 @@
+"""Version of the cloud-tpu framework.
+
+Reference analogue: src/python/tensorflow_cloud/version.py:16.
+"""
+
+__version__ = "0.1.0.dev"

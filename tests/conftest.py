@@ -1,0 +1,16 @@
+"""Test harness: force an 8-device virtual CPU platform before jax imports.
+
+This is the TPU-collectives test rig from SURVEY.md §4: multi-chip sharding
+code is exercised on ``--xla_force_host_platform_device_count=8`` CPU devices
+(the analogue of the reference faking clusters via TF_CONFIG env,
+cloud_fit/tests/unit/remote_test.py:76-82).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

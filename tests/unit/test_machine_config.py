@@ -1,0 +1,165 @@
+"""Table-driven resource-model tests.
+
+Pattern parity: reference core/tests/unit/gcp_test.py (table-driven
+ValueError tests) and machine_config semantics (machine_config.py:58-185).
+"""
+
+import pytest
+
+from cloud_tpu.core import gcp, machine_config
+
+AT = machine_config.AcceleratorType
+MC = machine_config.MachineConfig
+
+
+class TestTpuTopologyCatalog:
+    def test_default_tpu_preset_is_v5e_8(self):
+        cfg = machine_config.COMMON_MACHINE_CONFIGS["TPU"]
+        topo = cfg.tpu_topology()
+        assert topo.accelerator_type == "v5litepod-8"
+        assert topo.chips == 8
+        assert topo.hosts == 1
+        assert topo.topology == "2x4"
+
+    def test_catalog_chip_host_consistency(self):
+        for topo in machine_config.TPU_SLICE_CATALOG.values():
+            assert topo.chips % topo.hosts == 0, topo
+            assert topo.chips_per_host >= 1
+            # topology product equals chip count
+            dims = [int(d) for d in topo.topology.split("x")]
+            prod = 1
+            for d in dims:
+                prod *= d
+            assert prod == topo.chips, topo
+
+    def test_find_topology_resolves(self):
+        topo = machine_config.find_topology(AT.TPU_V5E, 32)
+        assert topo.accelerator_type == "v5litepod-32"
+        assert topo.hosts == 8
+
+    def test_find_topology_rejects_illegal_chip_count(self):
+        with pytest.raises(ValueError, match="Legal chip counts"):
+            machine_config.find_topology(AT.TPU_V5E, 7)
+
+    def test_find_topology_rejects_wrong_topology_string(self):
+        with pytest.raises(ValueError):
+            machine_config.find_topology(AT.TPU_V5E, 8, topology="4x2")
+
+
+class TestMachineConfig:
+    def test_tpu_config_requires_legal_slice(self):
+        with pytest.raises(ValueError):
+            MC(accelerator_type=AT.TPU_V4, accelerator_count=6)
+
+    def test_cpu_config_rejects_accelerator_count(self):
+        with pytest.raises(ValueError, match="accelerator_count"):
+            MC(accelerator_type=AT.NO_ACCELERATOR, accelerator_count=2)
+
+    def test_accelerator_type_must_be_enum(self):
+        with pytest.raises(ValueError, match="AcceleratorType"):
+            MC(accelerator_type="TPU_V4", accelerator_count=8)
+
+    def test_is_tpu_config(self):
+        assert machine_config.is_tpu_config(
+            machine_config.COMMON_MACHINE_CONFIGS["TPU_V4_8"]
+        )
+        assert not machine_config.is_tpu_config(
+            machine_config.COMMON_MACHINE_CONFIGS["CPU"]
+        )
+        assert not machine_config.is_tpu_config(None)
+        assert not machine_config.is_tpu_config(
+            machine_config.COMMON_MACHINE_CONFIGS["T4_1X"]
+        )
+
+    def test_gpu_migration_hint_names_tpu_preset(self):
+        hint = machine_config.gpu_migration_hint(
+            machine_config.COMMON_MACHINE_CONFIGS["T4_4X"]
+        )
+        assert "TPU_V5E" in hint
+
+    def test_common_configs_all_valid(self):
+        # Every preset must satisfy its own invariants (post_init runs).
+        for name, cfg in machine_config.COMMON_MACHINE_CONFIGS.items():
+            assert isinstance(cfg, MC), name
+
+
+class TestGcpTables:
+    def test_accelerator_type_string(self):
+        assert (
+            gcp.get_accelerator_type(machine_config.COMMON_MACHINE_CONFIGS["TPU"])
+            == "v5litepod-8"
+        )
+
+    def test_accelerator_type_rejects_gpu_with_hint(self):
+        with pytest.raises(ValueError, match="TPU"):
+            gcp.get_accelerator_type(machine_config.COMMON_MACHINE_CONFIGS["T4_1X"])
+
+    def test_machine_type_tpu_tracks_chips_per_host(self):
+        # v5e-8 is a single-host slice: 8 chips on one host -> -8t machine.
+        assert (
+            gcp.get_machine_type(machine_config.COMMON_MACHINE_CONFIGS["TPU_V5E_8"])
+            == "ct5lp-hightpu-8t"
+        )
+        # v5e-32 spans 8 hosts x 4 chips -> -4t machines.
+        assert (
+            gcp.get_machine_type(machine_config.COMMON_MACHINE_CONFIGS["TPU_V5E_32"])
+            == "ct5lp-hightpu-4t"
+        )
+        assert (
+            gcp.get_machine_type(machine_config.COMMON_MACHINE_CONFIGS["TPU_V2"])
+            == "n1-standard-96"
+        )
+
+    def test_machine_type_cpu(self):
+        assert gcp.get_machine_type(MC(cpu_cores=8, memory=30)) == "n1-standard-8"
+
+    def test_machine_type_rejects_bad_cpu_combo(self):
+        with pytest.raises(ValueError, match="Legal combinations"):
+            gcp.get_machine_type(MC(cpu_cores=7, memory=9))
+
+    def test_validate_machine_configuration_gpu_rejected(self):
+        with pytest.raises(ValueError, match="Nearest TPU equivalent"):
+            gcp.validate_machine_configuration(8, 30, AT.NVIDIA_TESLA_T4, 1)
+
+    def test_zone_generation_aware(self, monkeypatch):
+        monkeypatch.delenv("CLOUD_TPU_ZONE", raising=False)
+        v4 = machine_config.COMMON_MACHINE_CONFIGS["TPU_V4_8"]
+        assert gcp.get_zone(v4) == "us-central2-b"
+        assert gcp.get_region(v4) == "us-central2"
+
+    def test_zone_env_override(self, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_ZONE", "europe-west4-b")
+        assert gcp.get_zone() == "europe-west4-b"
+        assert gcp.get_region() == "europe-west4"
+
+    def test_project_from_env(self, monkeypatch):
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "my-proj")
+        assert gcp.get_project_name() == "my-proj"
+
+
+class TestJobLabels:
+    """Reference parity: gcp.py:409-481 label rules."""
+
+    def test_valid_labels_pass(self):
+        gcp.validate_job_labels({"team": "research", "phase_1": "a-b_c"})
+
+    def test_none_and_empty_pass(self):
+        gcp.validate_job_labels(None)
+        gcp.validate_job_labels({})
+
+    def test_too_many_labels(self):
+        labels = {f"k{i}": "v" for i in range(65)}
+        with pytest.raises(ValueError, match="Too many"):
+            gcp.validate_job_labels(labels)
+
+    @pytest.mark.parametrize(
+        "key", ["Upper", "1start", "_lead", "a" * 64, "goog-x", "has space"]
+    )
+    def test_bad_keys(self, key):
+        with pytest.raises(ValueError):
+            gcp.validate_job_labels({key: "v"})
+
+    @pytest.mark.parametrize("value", ["UPPER", "v" * 64, "sp ace", "val\n"])
+    def test_bad_values(self, value):
+        with pytest.raises(ValueError):
+            gcp.validate_job_labels({"key": value})
