@@ -1,0 +1,87 @@
+"""Benchmark driver: ResNet-50 train-step throughput per chip.
+
+Measures the BASELINE.json north-star workload (ResNet50 steps/sec/chip,
+CIFAR-10 config) on the available accelerator and prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline"}``.
+
+The reference publishes no numbers (BASELINE.md: "published": {}), so
+``vs_baseline`` is reported against this repo's own recorded baseline in
+BASELINE.md once set; until then 1.0.
+"""
+
+import functools
+import json
+import time
+
+import numpy as np
+
+
+BATCH_SIZE = 256
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+#: Filled from the first recorded run (BASELINE.md); ratio reported as
+#: vs_baseline thereafter.
+RECORDED_BASELINE_STEPS_PER_SEC = None
+
+
+def main():
+    import jax
+    import optax
+
+    from cloud_tpu.models import resnet
+    from cloud_tpu.training import train as train_lib
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    config = resnet.RESNET50_CIFAR
+
+    state = train_lib.create_sharded_state(
+        jax.random.PRNGKey(0),
+        functools.partial(resnet.init, config=config),
+        optax.sgd(0.1, momentum=0.9),
+        mesh=None,
+    )
+    step = train_lib.make_train_step(
+        functools.partial(resnet.loss_fn, config=config),
+        optax.sgd(0.1, momentum=0.9),
+    )
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": rng.normal(size=(BATCH_SIZE, 32, 32, 3)).astype(np.float32),
+        "label": rng.integers(0, 10, BATCH_SIZE),
+    }
+    batch = jax.device_put(batch)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    start = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    elapsed = time.perf_counter() - start
+
+    steps_per_sec = MEASURE_STEPS / elapsed
+    per_chip = steps_per_sec / n_chips
+    vs_baseline = (
+        per_chip / RECORDED_BASELINE_STEPS_PER_SEC
+        if RECORDED_BASELINE_STEPS_PER_SEC
+        else 1.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"resnet50_cifar10_b{BATCH_SIZE}_train_steps_per_sec_per_chip",
+                "value": round(per_chip, 3),
+                "unit": "steps/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

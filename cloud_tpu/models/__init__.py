@@ -1,0 +1,19 @@
+"""Model zoo: functional JAX models with logical-axis sharded parameters.
+
+Every model module exposes the same functional surface:
+
+- ``Config`` dataclass (static hyperparameters)
+- ``init(rng, config) -> params`` pytree
+- ``apply(params, inputs, config, ...) -> outputs``
+- ``param_logical_axes(config)`` — a pytree congruent with ``params`` whose
+  leaves are tuples of logical axis names (see ``parallel/sharding.py``)
+- ``loss_fn(params, batch, config, ...) -> (loss, metrics)``
+
+The reference's model surface was whatever Keras script the user shipped
+(golden workloads in core/tests/testdata/); this zoo carries the equivalent
+built-in workloads: MNIST dense (mnist_example_using_fit.py), ResNet50 /
+CIFAR-10, BERT fine-tune, and the flagship CloudLM decoder used for
+long-context and multi-axis parallelism.
+"""
+
+from cloud_tpu.models import layers  # noqa: F401

@@ -1,0 +1,168 @@
+"""BERT-base encoder for fine-tuning — BASELINE.json config 3.
+
+Bidirectional transformer encoder (learned positions, post-attention
+LayerNorm pairs, GELU MLP) with a pooled classification head.  Shares the
+logical-axis sharding vocabulary with CloudLM, so the same mesh plans apply
+(fsdp/tp for the pod fine-tune config).
+
+Reference analogue: the "Multi-worker BERT-base fine-tune
+(MultiWorkerMirroredStrategy NCCL -> TPU pod ICI)" baseline workload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloud_tpu.models import layers
+from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules, shard_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    num_layers: int = 12
+    dim: int = 768
+    num_heads: int = 12
+    mlp_hidden: int = 3072
+    max_seq_len: int = 512
+    num_classes: int = 2  # sequence classification head
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.num_heads
+
+
+BERT_BASE = BertConfig()
+TINY = BertConfig(
+    vocab_size=512, num_layers=2, dim=64, num_heads=4, mlp_hidden=128,
+    max_seq_len=64,
+)
+
+
+def _layer_init(rng, cfg: BertConfig):
+    r_att, r_mlp1, r_mlp2 = jax.random.split(rng, 3)
+    att, _ = layers.attention_block_init(r_att, cfg.dim, cfg.num_heads, cfg.head_dim)
+    ln1, _ = layers.layernorm_init(cfg.dim)
+    ln2, _ = layers.layernorm_init(cfg.dim)
+    wi, _ = layers.dense_init(
+        r_mlp1, cfg.dim, cfg.mlp_hidden, in_axis="embed", out_axis="mlp"
+    )
+    wo, _ = layers.dense_init(
+        r_mlp2, cfg.mlp_hidden, cfg.dim, in_axis="mlp", out_axis="embed"
+    )
+    return {"att": att, "ln1": ln1, "wi": wi, "wo": wo, "ln2": ln2}
+
+
+def init(rng, cfg: BertConfig = BERT_BASE) -> Dict[str, Any]:
+    r_tok, r_pos, r_seg, r_layers, r_pool, r_cls = jax.random.split(rng, 6)
+    tok, _ = layers.embedding_init(r_tok, cfg.vocab_size, cfg.dim)
+    pos, _ = layers.embedding_init(r_pos, cfg.max_seq_len, cfg.dim)
+    seg, _ = layers.embedding_init(r_seg, 2, cfg.dim)
+    ln_embed, _ = layers.layernorm_init(cfg.dim)
+    layer_rngs = jax.random.split(r_layers, cfg.num_layers)
+    stacked = jax.vmap(lambda r: _layer_init(r, cfg))(layer_rngs)
+    pooler, _ = layers.dense_init(r_pool, cfg.dim, cfg.dim, in_axis="embed",
+                                  out_axis=None)
+    classifier, _ = layers.dense_init(r_cls, cfg.dim, cfg.num_classes,
+                                      in_axis="embed", out_axis=None)
+    return {
+        "tok": tok, "pos": pos, "seg": seg, "ln_embed": ln_embed,
+        "layers": stacked, "pooler": pooler, "classifier": classifier,
+    }
+
+
+def param_logical_axes(cfg: BertConfig = BERT_BASE):
+    layer_axes = {
+        "att": layers.attention_block_axes(),
+        "ln1": {"scale": (None,), "bias": (None,)},
+        "wi": layers.dense_axes("embed", "mlp"),
+        "wo": layers.dense_axes("mlp", "embed"),
+        "ln2": {"scale": (None,), "bias": (None,)},
+    }
+    stacked = jax.tree_util.tree_map(
+        lambda ax: ("layers",) + tuple(ax), layer_axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    return {
+        "tok": {"table": ("vocab", "embed")},
+        "pos": {"table": (None, "embed")},
+        "seg": {"table": (None, "embed")},
+        "ln_embed": {"scale": (None,), "bias": (None,)},
+        "layers": stacked,
+        "pooler": layers.dense_axes("embed", None),
+        "classifier": layers.dense_axes("embed", None),
+    }
+
+
+def encode(
+    params, tokens, cfg: BertConfig = BERT_BASE, *,
+    attention_mask: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    rules: ShardingRules = DEFAULT_RULES,
+):
+    """tokens [B, T] -> contextual embeddings [B, T, D]."""
+    b, t = tokens.shape
+    x = layers.embedding_apply(params["tok"], tokens, dtype=cfg.dtype)
+    x = x + layers.embedding_apply(
+        params["pos"], jnp.broadcast_to(jnp.arange(t), (b, t)), dtype=cfg.dtype
+    )
+    if segment_ids is not None:
+        x = x + layers.embedding_apply(params["seg"], segment_ids, dtype=cfg.dtype)
+    x = layers.layernorm_apply(params["ln_embed"], x)
+    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules)
+
+    h, hd = cfg.num_heads, cfg.head_dim
+
+    def layer_body(x, lp):
+        def proj(p):
+            return layers.dense_apply(p, x).reshape(b, t, h, hd)
+
+        attended = layers.causal_attention(
+            proj(lp["att"]["q"]), proj(lp["att"]["k"]), proj(lp["att"]["v"]),
+            mask=attention_mask, causal=False,
+        )
+        att_out = layers.dense_apply(lp["att"]["out"], attended.reshape(b, t, -1))
+        x = layers.layernorm_apply(lp["ln1"], x + att_out)
+        mlp = layers.dense_apply(
+            lp["wo"], jax.nn.gelu(layers.dense_apply(lp["wi"], x))
+        )
+        x = layers.layernorm_apply(lp["ln2"], x + mlp)
+        x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_body, x, params["layers"])
+    return x
+
+
+def apply(
+    params, tokens, cfg: BertConfig = BERT_BASE, *,
+    attention_mask: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    rules: ShardingRules = DEFAULT_RULES,
+):
+    """Sequence classification: tokens [B, T] -> logits [B, num_classes]."""
+    x = encode(params, tokens, cfg, attention_mask=attention_mask,
+               segment_ids=segment_ids, rules=rules)
+    pooled = jnp.tanh(layers.dense_apply(params["pooler"], x[:, 0]))
+    return layers.dense_apply(params["classifier"], pooled, dtype=jnp.float32)
+
+
+def loss_fn(params, batch: Dict[str, jnp.ndarray],
+            cfg: BertConfig = BERT_BASE, *,
+            rules: ShardingRules = DEFAULT_RULES) -> Tuple[jnp.ndarray, Dict]:
+    logits = apply(
+        params, batch["tokens"], cfg,
+        attention_mask=batch.get("attention_mask"),
+        segment_ids=batch.get("segment_ids"), rules=rules,
+    )
+    labels = batch["label"]
+    log_probs = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(log_probs, labels[:, None], axis=-1))
+    accuracy = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": accuracy}

@@ -1,0 +1,184 @@
+"""Shared functional layers: init/apply pairs with logical-axis sharding.
+
+Design: parameters are plain dict pytrees; every layer has an ``init_*``
+returning (params, logical_axes) in congruent structure, and an ``apply``
+function.  Compute runs in the dtype of the inputs (bfloat16 on TPU — MXU
+native), while parameters stay float32; callers cast activations, never
+weights (the optimizer needs f32 master weights).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloud_tpu.parallel.sharding import ShardingRules, DEFAULT_RULES, shard_constraint
+
+
+def dense_axes(in_axis: Optional[str], out_axis: Optional[str],
+               use_bias: bool = True):
+    """Logical axes for a dense layer's params — the single source of truth
+    consumed by ``dense_init`` and every model's ``param_logical_axes``."""
+    axes = {"kernel": (in_axis, out_axis)}
+    if use_bias:
+        axes["bias"] = (out_axis,)
+    return axes
+
+
+def dense_init(rng, in_dim: int, out_dim: int, *, in_axis: Optional[str],
+               out_axis: Optional[str], use_bias: bool = True):
+    """Kernel [in, out] with truncated-normal fan-in scaling."""
+    stddev = 1.0 / math.sqrt(in_dim)
+    k_rng, _ = jax.random.split(rng)
+    params = {
+        "kernel": jax.random.truncated_normal(
+            k_rng, -2.0, 2.0, (in_dim, out_dim), jnp.float32
+        )
+        * stddev
+    }
+    if use_bias:
+        params["bias"] = jnp.zeros((out_dim,), jnp.float32)
+    return params, dense_axes(in_axis, out_axis, use_bias)
+
+
+def dense_apply(params, x, *, dtype=None):
+    dtype = dtype or x.dtype
+    y = jnp.einsum("...i,io->...o", x, params["kernel"].astype(dtype))
+    if "bias" in params:
+        y = y + params["bias"].astype(dtype)
+    return y
+
+
+def embedding_init(rng, vocab: int, dim: int, *, vocab_axis="vocab",
+                   embed_axis="embed"):
+    table = jax.random.normal(rng, (vocab, dim), jnp.float32) * 0.02
+    return {"table": table}, {"table": (vocab_axis, embed_axis)}
+
+
+def embedding_apply(params, token_ids, *, dtype=jnp.float32):
+    return jnp.take(params["table"].astype(dtype), token_ids, axis=0)
+
+
+def layernorm_init(dim: int, *, axis: Optional[str] = None):
+    return (
+        {"scale": jnp.ones((dim,), jnp.float32), "bias": jnp.zeros((dim,), jnp.float32)},
+        {"scale": (axis,), "bias": (axis,)},
+    )
+
+
+def layernorm_apply(params, x, *, eps: float = 1e-6):
+    # LN statistics in float32 for stability regardless of activation dtype.
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * params["scale"] + params["bias"]
+    return y.astype(x.dtype)
+
+
+def rmsnorm_init(dim: int, *, axis: Optional[str] = None):
+    return {"scale": jnp.ones((dim,), jnp.float32)}, {"scale": (axis,)}
+
+
+def rmsnorm_apply(params, x, *, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (y * params["scale"]).astype(x.dtype)
+
+
+def rotary_embedding(x, positions, *, base: float = 10000.0):
+    """RoPE applied to [..., T, H, D] with positions [..., T]."""
+    dim = x.shape[-1]
+    half = dim // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., T, half]
+    angles = angles[..., None, :]  # broadcast over heads: [..., T, 1, half]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def causal_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
+                     causal: bool = True):
+    """Reference (non-ring, non-Pallas) attention: [B, T, H, D] layout.
+
+    Softmax statistics in float32; matmuls stay in the input dtype so the
+    MXU sees bfloat16 operands.
+    """
+    dim = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dim)
+    scores = scores.astype(jnp.float32)
+    t_q, t_k = q.shape[1], k.shape[1]
+    # Finite mask value (not -inf): a fully-masked row (e.g. an all-padding
+    # example) then softmaxes to uniform garbage instead of NaN; the loss
+    # mask is responsible for dropping such rows.
+    neg = jnp.float32(-1e30)
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        scores = jnp.where(causal_mask, scores, neg)
+    if mask is not None:
+        # mask: [B, T_k] valid-token mask
+        scores = jnp.where(mask[:, None, None, :], scores, neg)
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def attention_block_axes():
+    return {
+        "q": dense_axes("embed", "heads", use_bias=False),
+        "k": dense_axes("embed", "heads", use_bias=False),
+        "v": dense_axes("embed", "heads", use_bias=False),
+        "out": dense_axes("heads", "embed", use_bias=False),
+    }
+
+
+def attention_block_init(rng, dim: int, num_heads: int, head_dim: int):
+    rngs = jax.random.split(rng, 4)
+    params = {}
+    for name, r, (i, o) in [
+        ("q", rngs[0], (dim, num_heads * head_dim)),
+        ("k", rngs[1], (dim, num_heads * head_dim)),
+        ("v", rngs[2], (dim, num_heads * head_dim)),
+    ]:
+        params[name], _ = dense_init(
+            r, i, o, in_axis="embed", out_axis="heads", use_bias=False
+        )
+    params["out"], _ = dense_init(
+        rngs[3], num_heads * head_dim, dim, in_axis="heads", out_axis="embed",
+        use_bias=False,
+    )
+    return params, attention_block_axes()
+
+
+def mlp_block_axes():
+    return {
+        "wi": dense_axes("embed", "mlp", use_bias=False),
+        "wg": dense_axes("embed", "mlp", use_bias=False),
+        "wo": dense_axes("mlp", "embed", use_bias=False),
+    }
+
+
+def mlp_block_init(rng, dim: int, hidden: int):
+    r1, r2, r3 = jax.random.split(rng, 3)
+    params = {}
+    for name, r, (i, o), (ia, oa) in [
+        ("wi", r1, (dim, hidden), ("embed", "mlp")),
+        ("wg", r2, (dim, hidden), ("embed", "mlp")),
+        ("wo", r3, (hidden, dim), ("mlp", "embed")),
+    ]:
+        params[name], _ = dense_init(r, i, o, in_axis=ia, out_axis=oa,
+                                     use_bias=False)
+    return params, mlp_block_axes()
+
+
+def mlp_block_apply(params, x, *, rules: ShardingRules = DEFAULT_RULES):
+    """Gated (SwiGLU) MLP with tp-sharded hidden dim."""
+    h = jax.nn.silu(dense_apply(params["wi"], x)) * dense_apply(params["wg"], x)
+    h = shard_constraint(h, "batch", "seq", "mlp", rules=rules)
+    return dense_apply(params["wo"], h)

@@ -1,0 +1,59 @@
+"""Dense MNIST classifier — the reference's golden minimal workload.
+
+Reference analogue: core/tests/testdata/mnist_example_using_fit.py (Keras
+Dense 512-relu -> 10-softmax on flattened 28x28).  First BASELINE.json
+config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloud_tpu.models import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class MnistConfig:
+    input_dim: int = 784
+    hidden_dim: int = 512
+    num_classes: int = 10
+
+
+def init(rng, config: MnistConfig = MnistConfig()):
+    r1, r2 = jax.random.split(rng)
+    h, _ = layers.dense_init(
+        r1, config.input_dim, config.hidden_dim, in_axis=None, out_axis="mlp"
+    )
+    out, _ = layers.dense_init(
+        r2, config.hidden_dim, config.num_classes, in_axis="mlp", out_axis=None
+    )
+    return {"hidden": h, "out": out}
+
+
+def param_logical_axes(config: MnistConfig = MnistConfig()):
+    return {
+        "hidden": {"kernel": (None, "mlp"), "bias": ("mlp",)},
+        "out": {"kernel": ("mlp", None), "bias": (None,)},
+    }
+
+
+def apply(params, images: jnp.ndarray, config: MnistConfig = MnistConfig()):
+    x = images.reshape(images.shape[0], -1)
+    x = jax.nn.relu(layers.dense_apply(params["hidden"], x))
+    return layers.dense_apply(params["out"], x)
+
+
+def loss_fn(params, batch: Dict[str, jnp.ndarray],
+            config: MnistConfig = MnistConfig()) -> Tuple[jnp.ndarray, Dict]:
+    logits = apply(params, batch["image"], config)
+    labels = batch["label"]
+    log_probs = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(
+        jnp.take_along_axis(log_probs, labels[:, None], axis=-1)
+    )
+    accuracy = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": accuracy}

@@ -1,0 +1,116 @@
+"""Mixture-of-experts MLP with capacity-based dense dispatch (GShard-style).
+
+The dispatch/combine tensors keep everything as large einsums — exactly what
+the MXU wants — and the stacked expert weights carry the ``expert`` logical
+axis so they shard over the ``ep`` mesh axis.  Tokens overflowing an
+expert's capacity are dropped (standard top-k capacity routing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloud_tpu.models import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+
+def moe_mlp_init(rng, dim: int, hidden: int, cfg: MoeConfig):
+    r_router, r_wi, r_wg, r_wo = jax.random.split(rng, 4)
+    router, _ = layers.dense_init(
+        r_router, dim, cfg.num_experts, in_axis="embed", out_axis=None,
+        use_bias=False,
+    )
+
+    def stack_init(r, i, o):
+        rs = jax.random.split(r, cfg.num_experts)
+        return jax.vmap(
+            lambda rr: layers.dense_init(
+                rr, i, o, in_axis=None, out_axis=None, use_bias=False
+            )[0]["kernel"]
+        )(rs)
+
+    params = {
+        "router": router,
+        "wi": stack_init(r_wi, dim, hidden),
+        "wg": stack_init(r_wg, dim, hidden),
+        "wo": stack_init(r_wo, hidden, dim),
+    }
+    return params, moe_mlp_axes()
+
+
+def moe_mlp_axes():
+    return {
+        "router": layers.dense_axes("embed", None, use_bias=False),
+        "wi": ("expert", "embed", "mlp"),
+        "wg": ("expert", "embed", "mlp"),
+        "wo": ("expert", "mlp", "embed"),
+    }
+
+
+def _capacity(tokens_per_batch: int, cfg: MoeConfig) -> int:
+    cap = int(tokens_per_batch * cfg.capacity_factor * cfg.top_k / cfg.num_experts)
+    return max(cap, cfg.top_k)
+
+
+def moe_mlp_apply(
+    params, x: jnp.ndarray, cfg: MoeConfig
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply the MoE MLP to ``x`` [B, T, D].
+
+    Returns (output [B, T, D], scalar load-balancing aux loss).
+    """
+    b, t, d = x.shape
+    e = cfg.num_experts
+    c = _capacity(t, cfg)
+
+    router_logits = layers.dense_apply(params["router"], x, dtype=jnp.float32)
+    gates = jax.nn.softmax(router_logits, axis=-1)  # [B, T, E]
+
+    # Top-k expert choice per token, gates renormalized over the chosen k.
+    top_gates, top_idx = jax.lax.top_k(gates, cfg.top_k)  # [B, T, K]
+    top_gates = top_gates / jnp.clip(
+        jnp.sum(top_gates, axis=-1, keepdims=True), 1e-9
+    )
+
+    # Position of each (token, choice) in its expert's buffer, via cumsum
+    # over the flattened (T*K) routing sequence per batch row.
+    choice_mask = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [B, T, K, E]
+    flat_mask = choice_mask.reshape(b, t * cfg.top_k, e)
+    pos_in_expert = (
+        jnp.cumsum(flat_mask, axis=1) - flat_mask
+    ).reshape(b, t, cfg.top_k, e)
+    within_capacity = pos_in_expert < c
+    keep = choice_mask * within_capacity
+
+    # combine[b,t,e,cap]: gate weight of token t's slot in expert e.
+    slot_one_hot = jax.nn.one_hot(
+        pos_in_expert.astype(jnp.int32), c, dtype=jnp.float32
+    )
+    combine = jnp.einsum(
+        "btke,btk,btkec->btec", keep, top_gates.astype(jnp.float32), slot_one_hot
+    )
+    dispatch = (combine > 0.0).astype(x.dtype)  # [B, T, E, C]
+
+    expert_in = jnp.einsum("btec,btd->becd", dispatch, x)
+    h = jax.nn.silu(
+        jnp.einsum("becd,edh->bech", expert_in, params["wi"].astype(x.dtype))
+    ) * jnp.einsum("becd,edh->bech", expert_in, params["wg"].astype(x.dtype))
+    expert_out = jnp.einsum("bech,ehd->becd", h, params["wo"].astype(x.dtype))
+    out = jnp.einsum("btec,becd->btd", combine.astype(x.dtype), expert_out)
+
+    # Load-balance loss: encourages uniform routing (Switch/GShard form).
+    fraction_routed = jnp.mean(choice_mask[..., 0, :], axis=(0, 1))  # top-1 share
+    mean_gate = jnp.mean(gates, axis=(0, 1))
+    aux = jnp.sum(fraction_routed * mean_gate) * e * cfg.aux_loss_weight
+    return out, aux

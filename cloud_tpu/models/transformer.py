@@ -1,0 +1,247 @@
+"""CloudLM: the flagship decoder-only transformer.
+
+Architecture: pre-RMSNorm, RoPE, SwiGLU MLP (optionally MoE), tied layer
+stack scanned with ``lax.scan``.  Every tensor carries logical sharding
+axes, so one model definition runs under any mesh layout the planner
+produces:
+
+- ``tp``: heads and MLP hidden sharded (kernels' ``heads``/``mlp`` axes)
+- ``fsdp``: parameter ``embed`` axes sharded (ZeRO-3)
+- ``sp`` > 1: attention runs as ring attention over sequence blocks
+- ``pp`` > 1: the scanned layer-stack dim shards over ``pp`` (use rules
+  ``extended(layers="stage")``); upgraded to microbatched pipelining by
+  ``parallel/pipeline.py``
+- ``ep`` > 1: MoE expert dim sharded
+
+The reference shipped no models — its golden workloads were user Keras
+scripts (core/tests/testdata/).  CloudLM is this framework's built-in
+long-context workload and the BERT/LM benchmark backbone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from cloud_tpu.models import layers, moe as moe_lib
+from cloud_tpu.parallel import mesh as mesh_lib
+from cloud_tpu.parallel.ring_attention import ring_attention
+from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules, shard_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    dim: int = 768
+    num_heads: int = 12
+    head_dim: int = 64
+    mlp_hidden: int = 3072
+    max_seq_len: int = 2048
+    moe: Optional[moe_lib.MoeConfig] = None  # None -> dense SwiGLU MLP
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    rope_base: float = 10000.0
+
+    def scaled(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+
+#: Tiny config for tests/dry-runs.
+TINY = TransformerConfig(
+    vocab_size=256, num_layers=4, dim=64, num_heads=4, head_dim=16,
+    mlp_hidden=128, max_seq_len=128, remat=False,
+)
+
+#: ~124M-parameter single-chip benchmark config (GPT-2-small shape).
+SMALL = TransformerConfig(
+    vocab_size=32000, num_layers=12, dim=768, num_heads=12, head_dim=64,
+    mlp_hidden=3072, max_seq_len=1024,
+)
+
+
+def _layer_init(rng, config: TransformerConfig):
+    r_att, r_mlp, rn1, rn2 = jax.random.split(rng, 4)
+    att, att_axes = layers.attention_block_init(
+        r_att, config.dim, config.num_heads, config.head_dim
+    )
+    ln1, ln1_axes = layers.rmsnorm_init(config.dim)
+    ln2, ln2_axes = layers.rmsnorm_init(config.dim)
+    if config.moe is not None:
+        mlp, mlp_axes = moe_lib.moe_mlp_init(
+            r_mlp, config.dim, config.mlp_hidden, config.moe
+        )
+    else:
+        mlp, mlp_axes = layers.mlp_block_init(r_mlp, config.dim, config.mlp_hidden)
+    return (
+        {"att": att, "ln1": ln1, "mlp": mlp, "ln2": ln2},
+        {"att": att_axes, "ln1": ln1_axes, "mlp": mlp_axes, "ln2": ln2_axes},
+    )
+
+
+def init(rng, config: TransformerConfig) -> Dict[str, Any]:
+    r_embed, r_layers, r_head, r_ln = jax.random.split(rng, 4)
+    embed, _ = layers.embedding_init(r_embed, config.vocab_size, config.dim)
+    layer_rngs = jax.random.split(r_layers, config.num_layers)
+    stacked = jax.vmap(lambda r: _layer_init(r, config)[0])(layer_rngs)
+    ln_f, _ = layers.rmsnorm_init(config.dim)
+    head, _ = layers.dense_init(
+        r_head, config.dim, config.vocab_size, in_axis="embed",
+        out_axis="vocab", use_bias=False,
+    )
+    return {"embed": embed, "layers": stacked, "ln_f": ln_f, "head": head}
+
+
+def param_logical_axes(config: TransformerConfig):
+    """Pytree congruent with init()'s output; leaves = logical axis tuples.
+
+    The stacked layer dim gets the ``layers`` logical axis (maps to ``pp``
+    under pipeline rules, replicated otherwise).
+    """
+    _, layer_axes = _layer_init_axes(config)
+    stacked_axes = jax.tree_util.tree_map(
+        lambda ax: ("layers",) + tuple(ax), layer_axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    return {
+        "embed": {"table": ("vocab", "embed")},
+        "layers": stacked_axes,
+        "ln_f": {"scale": (None,)},
+        "head": {"kernel": ("embed", "vocab")},
+    }
+
+
+def _layer_init_axes(config: TransformerConfig):
+    # Single source of truth: the same axes tables the layer init functions
+    # return (layers.py / moe.py companions), composed per layer.
+    if config.moe is not None:
+        mlp_axes = moe_lib.moe_mlp_axes()
+    else:
+        mlp_axes = layers.mlp_block_axes()
+    axes = {
+        "att": layers.attention_block_axes(),
+        "ln1": {"scale": (None,)},
+        "mlp": mlp_axes,
+        "ln2": {"scale": (None,)},
+    }
+    return None, axes
+
+
+def _attention(
+    x, att_params, config: TransformerConfig, rules: ShardingRules,
+    mesh, positions,
+):
+    b, t, _ = x.shape
+    h, hd = config.num_heads, config.head_dim
+
+    def proj(p):
+        y = layers.dense_apply(p, x)
+        return y.reshape(b, t, h, hd)
+
+    q = layers.rotary_embedding(
+        proj(att_params["q"]), positions, base=config.rope_base
+    )
+    k = layers.rotary_embedding(
+        proj(att_params["k"]), positions, base=config.rope_base
+    )
+    v = proj(att_params["v"])
+    q = shard_constraint(q, "batch", "seq", "heads", None, rules=rules, mesh=mesh)
+    k = shard_constraint(k, "batch", "seq", "heads", None, rules=rules, mesh=mesh)
+    v = shard_constraint(v, "batch", "seq", "heads", None, rules=rules, mesh=mesh)
+
+    sp_size = mesh.shape.get(mesh_lib.AXIS_SP, 1) if mesh is not None else 1
+    if sp_size > 1:
+        # Sequence blocks are distributed: run the ring.
+        batch_axes = rules.assignment("batch")
+        heads_axes = rules.assignment("heads")
+        spec = PartitionSpec(batch_axes, mesh_lib.AXIS_SP, heads_axes, None)
+        attended = jax.shard_map(
+            partial(ring_attention, axis=mesh_lib.AXIS_SP, causal=True),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            # The online-softmax accumulators start replicated and become
+            # axis-varying inside the fori_loop; skip VMA carry checking.
+            check_vma=False,
+        )(q, k, v)
+    else:
+        attended = layers.causal_attention(q, k, v)
+
+    attended = attended.reshape(b, t, h * hd)
+    return layers.dense_apply(att_params["out"], attended)
+
+
+def apply(
+    params,
+    tokens: jnp.ndarray,
+    config: TransformerConfig,
+    *,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward pass: tokens [B, T] -> (logits [B, T, V], aux loss scalar)."""
+    mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
+    b, t = tokens.shape
+    x = layers.embedding_apply(params["embed"], tokens, dtype=config.dtype)
+    x = x * math.sqrt(config.dim)
+    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules, mesh=mesh)
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+
+    def layer_body(carry, layer_params):
+        x, aux = carry
+        y = layers.rmsnorm_apply(layer_params["ln1"], x)
+        x = x + _attention(y, layer_params["att"], config, rules, mesh, positions)
+        y = layers.rmsnorm_apply(layer_params["ln2"], x)
+        if config.moe is not None:
+            mlp_out, layer_aux = moe_lib.moe_mlp_apply(
+                layer_params["mlp"], y, config.moe
+            )
+            aux = aux + layer_aux
+        else:
+            mlp_out = layers.mlp_block_apply(layer_params["mlp"], y, rules=rules)
+        x = x + mlp_out
+        x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules, mesh=mesh)
+        return (x, aux), None
+
+    body = layer_body
+    if config.remat:
+        body = jax.checkpoint(layer_body)
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+
+    x = layers.rmsnorm_apply(params["ln_f"], x)
+    logits = layers.dense_apply(params["head"], x, dtype=jnp.float32)
+    logits = shard_constraint(logits, "batch", "seq", "vocab", rules=rules, mesh=mesh)
+    return logits, aux
+
+
+def loss_fn(
+    params,
+    batch: Dict[str, jnp.ndarray],
+    config: TransformerConfig,
+    *,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Next-token cross-entropy; batch = {"tokens": [B, T]} (optionally
+    "loss_mask" [B, T])."""
+    tokens = batch["tokens"]
+    logits, aux = apply(params, tokens, config, rules=rules, mesh=mesh)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask[:, 1:].astype(jnp.float32)
+        denom = jnp.clip(jnp.sum(mask), 1.0)
+        ce = jnp.sum(nll * mask) / denom
+    else:
+        ce = jnp.mean(nll)
+    loss = ce + aux
+    return loss, {"loss": loss, "ce": ce, "aux": aux}

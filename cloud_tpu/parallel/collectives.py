@@ -1,0 +1,71 @@
+"""Thin named-axis collective helpers for use inside ``shard_map``.
+
+XLA inserts collectives automatically for pjit-sharded code; these wrappers
+exist for the explicitly-scheduled paths (ring attention, pipeline) and for
+readability at call sites.  All take mesh axis names, never device ids —
+the TPU-native replacement for the reference's NCCL/gRPC CollectiveOps
+backends (SURVEY.md §2.6), which lived inside tf.distribute.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisNames = Union[str, Sequence[str]]
+
+
+def all_reduce_sum(x, axis: AxisNames):
+    return lax.psum(x, axis)
+
+
+def all_reduce_mean(x, axis: AxisNames):
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: str, *, gather_dim: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter_sum(x, axis: str, *, scatter_dim: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def ring_permute(x, axis: str, *, shift: int = 1):
+    """Send this shard to the neighbour ``shift`` positions along ``axis``.
+
+    On TPU the resulting ``ppermute`` rides nearest-neighbour ICI links,
+    which is what makes ring attention and pipeline transfers overlap with
+    compute.
+    """
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)
+
+
+def barrier(axis: AxisNames):
+    """Cross-device synchronization point (a trivial psum)."""
+    return lax.psum(jnp.zeros((), jnp.int32), axis)
+
+
+def broadcast_from(x, axis: str, *, root: int = 0):
+    """Every member of ``axis`` gets root's value."""
+    idx = lax.axis_index(axis)
+    zero = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(zero, axis)
+
+
+def host_local_mean(tree):
+    """jnp mean of a pytree across all devices outside shard_map (jit-level)."""
+    return jax.tree_util.tree_map(jnp.mean, tree)

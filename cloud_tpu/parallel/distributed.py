@@ -1,0 +1,99 @@
+"""Multi-host runtime initialization: the cross-process contract.
+
+Replaces the reference's generated TPU resolver-wait prologue
+(preprocess.py:215-262, a 40 x 10 s poll for the ``TPU_CONFIG`` env var) and
+its reliance on CAIP-injected ``TF_CONFIG``.  On Cloud TPU VMs,
+``jax.distributed.initialize()`` auto-discovers the coordinator from TPU
+metadata; off-TPU (tests, CPU fleets) the ``CLOUD_TPU_COORDINATOR`` /
+``CLOUD_TPU_NUM_PROCESSES`` / ``CLOUD_TPU_PROCESS_ID`` env vars carry the
+topology — set by our deploy layer's startup script (core/deploy.py).
+
+Env contract (every variable optional on TPU VMs):
+
+- ``CLOUD_TPU_COORDINATOR``    host:port of process 0
+- ``CLOUD_TPU_NUM_PROCESSES``  total process count
+- ``CLOUD_TPU_PROCESS_ID``     this process's rank
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_COORDINATOR = "CLOUD_TPU_COORDINATOR"
+ENV_NUM_PROCESSES = "CLOUD_TPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "CLOUD_TPU_PROCESS_ID"
+
+_INITIALIZED = False
+
+
+def initialize_from_env(timeout_seconds: Optional[int] = None) -> bool:
+    """Initialize jax.distributed if this is a multi-process job.
+
+    Returns True when distributed init ran (or already had), False for
+    single-process jobs.  Idempotent — safe to call from both the bootstrap
+    runner and user code (mirroring the reference's re-entrant ``remote()``
+    guard philosophy, run.py:31-33).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+
+    import jax
+
+    coordinator = os.environ.get(ENV_COORDINATOR)
+    num_processes = os.environ.get(ENV_NUM_PROCESSES)
+    process_id = os.environ.get(ENV_PROCESS_ID)
+
+    if coordinator:
+        kwargs = dict(
+            coordinator_address=coordinator,
+            num_processes=int(num_processes) if num_processes else None,
+            process_id=int(process_id) if process_id else None,
+        )
+        if timeout_seconds is not None:
+            kwargs["initialization_timeout"] = timeout_seconds
+        logger.info("jax.distributed.initialize(%s)", kwargs)
+        jax.distributed.initialize(**kwargs)
+        _INITIALIZED = True
+        return True
+
+    if _on_tpu_vm_pod():
+        # TPU metadata supplies coordinator/topology automatically.
+        logger.info("jax.distributed.initialize() via TPU metadata")
+        jax.distributed.initialize()
+        _INITIALIZED = True
+        return True
+
+    logger.debug("single-process run; skipping jax.distributed")
+    return False
+
+
+def _on_tpu_vm_pod() -> bool:
+    """True when running on a TPU VM that is part of a multi-host slice."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hostnames.split(",") if h]) > 1
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_chief() -> bool:
+    """Process 0 is the chief (checkpoint writer, log owner).
+
+    Analogue of the reference's ``TF_CONFIG``-derived chief detection
+    (cloud_fit/remote.py:148-156).
+    """
+    return process_index() == 0

@@ -1,0 +1,108 @@
+"""Ring attention: exact long-context attention over the ``sp`` mesh axis.
+
+Each device holds one sequence block of Q, K, V.  K/V blocks rotate around
+the ring via ``ppermute`` (nearest-neighbour ICI links on TPU) while every
+device folds the incoming block into an online-softmax accumulator — the
+blockwise log-sum-exp trick from flash attention, distributed.  After
+``sp`` hops every query block has attended to every key block, with peak
+memory O(T/sp) per device and communication overlapped with the block
+matmuls by XLA's async collective scheduling.
+
+No reference counterpart exists (SURVEY.md §5: sequence parallelism absent);
+this is the capability the TPU-native build adds for long-context scale.
+
+Call under ``shard_map`` with the sequence dim of q/k/v sharded over
+``axis``; batch/head dims may be sharded over other axes — the computation
+is independent along them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis: str,
+    *,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact attention over sequence blocks distributed along ``axis``.
+
+    Args:
+      q, k, v: local blocks ``[B, T_local, H, D]`` (sequence dim sharded
+        over ``axis``; block i holds global positions
+        ``[i*T_local, (i+1)*T_local)``).
+      axis: mesh axis name carrying the sequence shards.
+      causal: apply a causal mask in *global* positions.
+
+    Returns:
+      Local attention output block ``[B, T_local, H, D]`` in q's dtype.
+    """
+    n = lax.axis_size(axis)
+    my_idx = lax.axis_index(axis)
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    q_pos = my_idx * t + jnp.arange(t)  # global positions of local queries
+
+    def fold_block(carry, _i, k_blk, v_blk, src_idx):
+        m_acc, l_acc, o_acc = carry
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+        if causal:
+            k_pos = src_idx * t + jnp.arange(t)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [T_q, T_k]
+            s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)  # [B, H, T_q]
+        m_new = jnp.maximum(m_acc, m_blk)
+        # renormalize previous accumulator to the new max
+        correction = jnp.exp(m_acc - m_new)
+        p = jnp.exp(s - m_new[..., None])  # [B, H, T_q, T_k]
+        l_new = l_acc * correction + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v_blk)
+        o_new = o_acc * correction.transpose(0, 2, 1)[..., None] + pv.astype(
+            jnp.float32
+        )
+        return m_new, l_new, o_new
+
+    def body(i, carry):
+        m_acc, l_acc, o_acc, k_cur, v_cur = carry
+        # Block currently held originated at rank (my_idx - i) mod n.
+        src_idx = jax.lax.rem(my_idx - i + n, n)
+        m_acc, l_acc, o_acc = fold_block(
+            (m_acc, l_acc, o_acc), i, k_cur, v_cur, src_idx
+        )
+        k_nxt = _rotate(k_cur, axis, n)
+        v_nxt = _rotate(v_cur, axis, n)
+        return m_acc, l_acc, o_acc, k_nxt, v_nxt
+
+    m0 = jnp.full((b, h, t), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    # Loop runs n-1 hops (each fold + rotate); the final block is folded
+    # outside so no dead K/V rotation ships on the last hop (a fori_loop
+    # body is compiled once — XLA cannot trim it per-iteration).
+    m, l, o, k_last, v_last = lax.fori_loop(0, n - 1, body, (m0, l0, o0, k, v))
+    m, l, o = fold_block(
+        (m, l, o), n - 1, k_last, v_last, jax.lax.rem(my_idx - (n - 1) + n, n)
+    )
+
+    # l==0 only for globally-masked rows (cannot happen with causal=True);
+    # guard anyway so padding-only rows return zeros, not NaN.
+    l_t = l.transpose(0, 2, 1)[..., None]  # [B, T, H, 1]
+    out = o / jnp.where(l_t == 0.0, 1.0, l_t)
+    return out.astype(q.dtype)
+
+
+def _rotate(x, axis, n):
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)

@@ -1,0 +1,107 @@
+"""Logical-axis sharding rules: how tensors map onto the mesh.
+
+Models annotate tensors with *logical* axis names (``batch``, ``embed``,
+``heads`` ...).  A :class:`ShardingRules` table translates those to mesh
+axes, producing ``PartitionSpec``/``NamedSharding``.  Changing the
+parallelism layout of a model = swapping the rules table — model code never
+mentions mesh axes directly.
+
+This replaces the reference's strategy dichotomy (Mirrored vs MWMS vs
+TPUStrategy, preprocess.py:124-149): one rules table expresses DP, FSDP, TP,
+SP and EP simultaneously as an assignment of logical axes to mesh axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from cloud_tpu.parallel import mesh as mesh_lib
+
+#: A logical axis maps to one mesh axis, a tuple of mesh axes (the tensor
+#: dimension is sharded over their product), or None (replicated).
+MeshAxisAssignment = Union[None, str, Tuple[str, ...]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    rules: Dict[str, MeshAxisAssignment]
+
+    def assignment(self, logical_axis: Optional[str]) -> MeshAxisAssignment:
+        if logical_axis is None:
+            return None
+        if logical_axis not in self.rules:
+            raise KeyError(
+                f"No sharding rule for logical axis {logical_axis!r}; "
+                f"known axes: {sorted(self.rules)}"
+            )
+        return self.rules[logical_axis]
+
+    def spec(self, *logical_axes: Optional[str]) -> PartitionSpec:
+        """PartitionSpec for a tensor whose dims carry these logical axes."""
+        return PartitionSpec(*(self.assignment(a) for a in logical_axes))
+
+    def extended(self, **overrides: MeshAxisAssignment) -> "ShardingRules":
+        merged = dict(self.rules)
+        merged.update(overrides)
+        return ShardingRules(merged)
+
+
+#: Default logical-axis table.  ``batch`` shards over every data-parallel
+#: mesh axis; parameters shard their ``embed`` dim over fsdp (ZeRO-3) and
+#: their head/mlp dims over tp; ``seq`` is the ring-attention axis.
+DEFAULT_RULES = ShardingRules(
+    {
+        "batch": (mesh_lib.AXIS_DP, mesh_lib.AXIS_FSDP),
+        "expert_batch": (mesh_lib.AXIS_DP, mesh_lib.AXIS_FSDP, mesh_lib.AXIS_EP),
+        "seq": mesh_lib.AXIS_SP,
+        "embed": mesh_lib.AXIS_FSDP,
+        # Activations shard on batch, never on the param-sharding axis —
+        # constraining an activation's feature dim with "embed" would reuse
+        # fsdp twice in one spec.
+        "act_embed": None,
+        "heads": mesh_lib.AXIS_TP,
+        "kv": None,
+        "mlp": mesh_lib.AXIS_TP,
+        "vocab": mesh_lib.AXIS_TP,
+        "expert": mesh_lib.AXIS_EP,
+        "layers": None,
+        "stage": mesh_lib.AXIS_PP,
+    }
+)
+
+
+def logical_to_mesh_axes(
+    logical_axes: Tuple[Optional[str], ...],
+    rules: ShardingRules = DEFAULT_RULES,
+) -> PartitionSpec:
+    return rules.spec(*logical_axes)
+
+
+def named_sharding(
+    mesh: Mesh,
+    *logical_axes: Optional[str],
+    rules: ShardingRules = DEFAULT_RULES,
+) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(*logical_axes))
+
+
+def shard_constraint(
+    x,
+    *logical_axes: Optional[str],
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh: Optional[Mesh] = None,
+):
+    """``with_sharding_constraint`` by logical axes, inside jit.
+
+    No-op when no mesh is active (single-device eager use), so model code is
+    unconditional.
+    """
+    mesh = mesh or mesh_lib.get_global_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    spec = rules.spec(*logical_axes)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

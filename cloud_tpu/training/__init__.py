@@ -1,0 +1,28 @@
+"""Training runtime: sharded train state/steps, Keras-fit-parity trainer.
+
+The reference delegated its hot loop entirely to ``model.fit()`` inside the
+remote container (SURVEY.md §3.1); here the loop is owned by the framework:
+a pjit-compiled train step over the planned mesh, driven by a Trainer with
+an explicit callback protocol (the serializable analogue of Keras
+callbacks, needed by cloud_fit — SURVEY.md §7 hard parts).
+"""
+
+from cloud_tpu.training.train import (
+    TrainState,
+    create_sharded_state,
+    make_eval_step,
+    make_train_step,
+    param_shardings,
+)
+from cloud_tpu.training.trainer import Callback, History, Trainer
+
+__all__ = [
+    "TrainState",
+    "Trainer",
+    "Callback",
+    "History",
+    "create_sharded_state",
+    "make_train_step",
+    "make_eval_step",
+    "param_shardings",
+]

@@ -1,0 +1,106 @@
+"""Async checkpointing via Orbax, with chief-aware save semantics.
+
+The reference delegated checkpointing to user code with chief-only save
+paths and non-chief throwaway dirs (cloud_fit/remote.py:130-145,
+testdata/save_and_load.py).  Orbax handles multi-host coordination natively
+(every process participates in writing its shards), so the "throwaway dir"
+dance disappears; what remains chief-only is bookkeeping like metric files.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax.checkpoint.CheckpointManager.
+
+    Keeps the framework's surface stable if orbax's API shifts, and adds
+    the trainer Callback adapter.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+
+        self._directory = os.fspath(directory)
+        self._manager = ocp.CheckpointManager(
+            self._directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> bool:
+        import orbax.checkpoint as ocp
+
+        return self._manager.save(step, args=ocp.args.StandardSave(state))
+
+    def restore(self, step: Optional[int] = None, *, template: Any = None):
+        import orbax.checkpoint as ocp
+
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"No checkpoints in {self._directory}")
+        if template is not None:
+            return self._manager.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        return self._manager.restore(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def wait(self) -> None:
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.close()
+
+
+class CheckpointCallback:
+    """Trainer callback: save every N steps and at train end."""
+
+    def __init__(self, directory: str, *, every_n_steps: int = 100,
+                 max_to_keep: int = 3):
+        self.directory = directory
+        self.every_n_steps = every_n_steps
+        self.max_to_keep = max_to_keep
+        self._manager: Optional[CheckpointManager] = None
+
+    # Lazily create the manager so the callback object stays cloudpickleable
+    # before/after training (managers hold thread pools).
+    def _get(self) -> CheckpointManager:
+        if self._manager is None:
+            self._manager = CheckpointManager(
+                self.directory, max_to_keep=self.max_to_keep,
+                save_interval_steps=self.every_n_steps,
+            )
+        return self._manager
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_manager"] = None
+        return state
+
+    def on_train_begin(self, trainer): ...
+    def on_epoch_begin(self, epoch, trainer): ...
+
+    def on_step_end(self, step, logs, trainer):
+        if step % self.every_n_steps == 0:
+            self._get().save(step, trainer.state)
+
+    def on_epoch_end(self, epoch, logs, trainer): ...
+
+    def on_train_end(self, trainer):
+        manager = self._get()
+        manager.save(int(trainer.state.step), trainer.state)
+        manager.wait()
+        manager.close()
+        self._manager = None

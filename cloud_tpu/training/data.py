@@ -1,0 +1,66 @@
+"""Minimal input pipelines: in-memory arrays and synthetic data.
+
+The at-scale TFRecord/GCS pipeline lives in ``cloud_tpu/training/records.py``
+(BASELINE config 5); this module covers the in-memory workloads the
+reference's golden scripts used (keras.datasets arrays).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class ArrayDataset:
+    """Re-iterable batched dataset over a dict of equal-length arrays.
+
+    ``dataset()`` yields dict batches — the zero-arg-callable contract the
+    Trainer expects (fresh iterator per epoch).
+    """
+
+    def __init__(
+        self,
+        arrays: Dict[str, np.ndarray],
+        batch_size: int,
+        *,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_remainder: bool = True,
+    ):
+        lengths = {k: len(v) for k, v in arrays.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"Unequal array lengths: {lengths}")
+        self.arrays = arrays
+        self.n = next(iter(lengths.values()))
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_remainder = drop_remainder
+        self._rng = np.random.default_rng(seed)
+        if batch_size > self.n:
+            raise ValueError(f"batch_size {batch_size} > dataset size {self.n}")
+
+    def __call__(self) -> Iterator[Dict[str, np.ndarray]]:
+        order = np.arange(self.n)
+        if self.shuffle:
+            self._rng.shuffle(order)
+        end = self.n - self.batch_size + 1 if self.drop_remainder else self.n
+        for start in range(0, end, self.batch_size):
+            idx = order[start : start + self.batch_size]
+            yield {k: v[idx] for k, v in self.arrays.items()}
+
+    def __len__(self) -> int:
+        if self.drop_remainder:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+
+def synthetic_tokens(
+    *, vocab_size: int, seq_len: int, batch_size: int, num_batches: int,
+    seed: int = 0,
+) -> ArrayDataset:
+    """Deterministic synthetic LM batches (benchmarks, smoke tests)."""
+    rng = np.random.default_rng(seed)
+    n = batch_size * num_batches
+    tokens = rng.integers(0, vocab_size, size=(n, seq_len), dtype=np.int32)
+    return ArrayDataset({"tokens": tokens}, batch_size)

@@ -1,0 +1,161 @@
+"""Sharded train state and pjit-compiled steps.
+
+All parallelism flows through data placement: parameters are initialized
+*directly into* their mesh shardings (via jit sharding propagation from
+logical-axis constraints — no host-side giant arrays), batches arrive
+sharded over the data axes, and XLA inserts the gradient all-reduces /
+all-gathers the layout implies.  This replaces the reference's
+strategy-object world (tf.distribute) with the SPMD model.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding
+
+from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+def param_shardings(
+    mesh: Mesh, logical_axes, rules: ShardingRules = DEFAULT_RULES
+):
+    """Map a logical-axes pytree to NamedShardings."""
+    return jax.tree_util.tree_map(
+        lambda axes: NamedSharding(mesh, rules.spec(*axes)),
+        logical_axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def _constrain(params, logical_axes, rules, mesh):
+    # Build the sharding tree from the axes tree first (axis tuples are
+    # pytree containers, so they can't ride along as a second tree).
+    shardings = param_shardings(mesh, logical_axes, rules)
+    return jax.tree_util.tree_map(
+        jax.lax.with_sharding_constraint, params, shardings
+    )
+
+
+def _constrain_opt_state(opt_state, params, logical_axes, rules, mesh):
+    """Pin params-shaped subtrees of an optax state (mu, nu, trace...) to the
+    parameter shardings; scalar leaves (step counts) stay replicated."""
+    params_treedef = jax.tree_util.tree_structure(params)
+
+    def is_params_like(subtree):
+        try:
+            return jax.tree_util.tree_structure(subtree) == params_treedef
+        except Exception:
+            return False
+
+    return jax.tree_util.tree_map(
+        lambda sub: _constrain(sub, logical_axes, rules, mesh)
+        if is_params_like(sub)
+        else sub,
+        opt_state,
+        is_leaf=is_params_like,
+    )
+
+
+def create_sharded_state(
+    rng,
+    init_fn: Callable[[Any], Any],
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh],
+    logical_axes=None,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> TrainState:
+    """Initialize a TrainState with parameters born sharded.
+
+    ``init_fn(rng) -> params``.  With a mesh, init runs under jit so each
+    device materializes only its parameter shards (crucial for models larger
+    than one host's memory); optimizer state inherits the same layout by
+    propagation.
+    """
+
+    def build(rng):
+        params = init_fn(rng)
+        if mesh is not None and logical_axes is not None:
+            params = _constrain(params, logical_axes, rules, mesh)
+        opt_state = optimizer.init(params)
+        if mesh is not None and logical_axes is not None:
+            # optax moment buffers are created via zeros_like, which carries
+            # no data dependence on params — GSPMD would replicate them.
+            # Constrain every params-congruent subtree to the param layout.
+            opt_state = _constrain_opt_state(
+                opt_state, params, logical_axes, rules, mesh
+            )
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state)
+
+    if mesh is None:
+        return build(rng)
+    with mesh:
+        return jax.jit(build)(rng)
+
+
+def make_train_step(
+    loss_fn: Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]],
+    optimizer: optax.GradientTransformation,
+    *,
+    logical_axes=None,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh: Optional[Mesh] = None,
+):
+    """Build ``step(state, batch) -> (state, metrics)``, jit-compiled.
+
+    ``loss_fn(params, batch) -> (loss, metrics)``.  The returned step
+    donates the input state (in-place buffer reuse on TPU — halves HBM
+    traffic for the optimizer update).
+    """
+
+    def step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (_, metrics), grads = grad_fn(state.params, batch)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        if mesh is not None and logical_axes is not None:
+            new_params = _constrain(new_params, logical_axes, rules, mesh)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def make_eval_step(loss_fn: Callable[..., Tuple[jnp.ndarray, Dict]]):
+    def eval_step(state: TrainState, batch) -> Dict:
+        _, metrics = loss_fn(state.params, batch)
+        return metrics
+
+    return jax.jit(eval_step)
+
+
+def shard_batch(batch, mesh: Optional[Mesh],
+                rules: ShardingRules = DEFAULT_RULES,
+                batch_axis: str = "batch"):
+    """Place a host-local batch pytree onto the mesh, sharded on dim 0."""
+    if mesh is None:
+        return batch
+
+    def place(x):
+        spec = rules.spec(*([batch_axis] + [None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, batch)

@@ -1,0 +1,205 @@
+"""Keras-fit-parity training loop with an explicit callback protocol.
+
+The reference's UX contract is ``model.fit(...)`` running remotely with
+user callbacks shipped via cloudpickle (cloud_fit client.py:173-180).  JAX
+has no Keras fit, so this Trainer provides the equivalent surface:
+epochs, steps, validation, History, and Callback hooks — all objects here
+are cloudpickle-serializable by construction (no locks, no device arrays
+held) so the cloud_fit path can ship them.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+import numpy as np
+
+from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules
+from cloud_tpu.training import train as train_lib
+
+logger = logging.getLogger(__name__)
+
+
+class Callback:
+    """Hook protocol (subset of Keras Callback the reference workloads use).
+
+    ``on_step_end`` receives metrics as *device arrays* (materializing them
+    with ``float()`` costs a host sync — do it sparingly); ``on_epoch_end``
+    logs are already host floats.
+    """
+
+    def on_train_begin(self, trainer: "Trainer") -> None: ...
+    def on_train_end(self, trainer: "Trainer") -> None: ...
+    def on_epoch_begin(self, epoch: int, trainer: "Trainer") -> None: ...
+    def on_epoch_end(self, epoch: int, logs: Dict[str, float],
+                     trainer: "Trainer") -> None: ...
+    def on_step_end(self, step: int, logs: Dict[str, float],
+                    trainer: "Trainer") -> None: ...
+
+
+class History(Callback):
+    """Accumulates per-epoch metric means (Keras History analogue)."""
+
+    def __init__(self):
+        self.history: Dict[str, List[float]] = {}
+
+    def on_epoch_end(self, epoch, logs, trainer):
+        for key, value in logs.items():
+            self.history.setdefault(key, []).append(float(value))
+
+
+class ProgressLogger(Callback):
+    def __init__(self, every_n_steps: int = 50):
+        self.every_n_steps = every_n_steps
+
+    def on_step_end(self, step, logs, trainer):
+        if step % self.every_n_steps == 0:
+            rendered = " ".join(
+                f"{k}={float(v):.4f}" for k, v in sorted(logs.items())
+            )
+            logger.info("step %d: %s", step, rendered)
+
+
+class LambdaCallback(Callback):
+    """Ad-hoc hooks, cloudpickle-friendly (reference ships these through
+    cloud_fit, remote_test.py:41-53)."""
+
+    def __init__(self, on_epoch_end: Optional[Callable] = None,
+                 on_step_end: Optional[Callable] = None):
+        self._on_epoch_end = on_epoch_end
+        self._on_step_end = on_step_end
+
+    def on_epoch_end(self, epoch, logs, trainer):
+        if self._on_epoch_end:
+            self._on_epoch_end(epoch, logs, trainer)
+
+    def on_step_end(self, step, logs, trainer):
+        if self._on_step_end:
+            self._on_step_end(step, logs, trainer)
+
+
+class Trainer:
+    """Owns the compiled step functions and the epoch loop.
+
+    Args:
+      loss_fn: ``loss_fn(params, batch) -> (loss, metrics_dict)``.
+      optimizer: optax transformation.
+      init_fn: ``init_fn(rng) -> params`` (used by ``init_state``).
+      mesh: parallelism mesh (None = single device).
+      logical_axes: params-congruent pytree of logical axis tuples.
+      rules: logical->mesh axis table.
+    """
+
+    def __init__(
+        self,
+        loss_fn,
+        optimizer,
+        init_fn=None,
+        *,
+        mesh=None,
+        logical_axes=None,
+        rules: ShardingRules = DEFAULT_RULES,
+    ):
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.init_fn = init_fn
+        self.mesh = mesh
+        self.logical_axes = logical_axes
+        self.rules = rules
+        self.state: Optional[train_lib.TrainState] = None
+        self.stop_training = False
+        self._train_step = train_lib.make_train_step(
+            loss_fn, optimizer, logical_axes=logical_axes, rules=rules, mesh=mesh
+        )
+        self._eval_step = train_lib.make_eval_step(loss_fn)
+
+    def init_state(self, rng) -> train_lib.TrainState:
+        if self.init_fn is None:
+            raise ValueError("Trainer needs init_fn to create state")
+        self.state = train_lib.create_sharded_state(
+            rng, self.init_fn, self.optimizer, self.mesh,
+            logical_axes=self.logical_axes, rules=self.rules,
+        )
+        return self.state
+
+    def fit(
+        self,
+        train_data: Callable[[], Iterable],
+        *,
+        epochs: int = 1,
+        steps_per_epoch: Optional[int] = None,
+        validation_data: Optional[Callable[[], Iterable]] = None,
+        callbacks: Optional[List[Callback]] = None,
+        state: Optional[train_lib.TrainState] = None,
+    ) -> History:
+        """Run the training loop.
+
+        ``train_data``/``validation_data`` are zero-arg callables returning a
+        fresh batch iterator per epoch (re-iterable datasets).
+        """
+        if state is not None:
+            self.state = state
+        if self.state is None:
+            raise ValueError("No TrainState; call init_state() or pass state=")
+        callbacks = list(callbacks or [])
+        history = History()
+        callbacks.append(history)
+        self.stop_training = False
+
+        for cb in callbacks:
+            cb.on_train_begin(self)
+        step = int(self.state.step)
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch, self)
+            epoch_metrics: Dict[str, List[float]] = {}
+            epoch_start = time.perf_counter()
+            for i, batch in enumerate(train_data()):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                batch = train_lib.shard_batch(batch, self.mesh, self.rules)
+                with self._mesh_context():
+                    self.state, metrics = self._train_step(self.state, batch)
+                step += 1
+                # Metrics stay on device: forcing float() here would block
+                # async dispatch and serialize host and TPU every step.
+                # Callbacks get the device arrays and pay the sync only if
+                # they materialize them.
+                for key, value in metrics.items():
+                    epoch_metrics.setdefault(key, []).append(value)
+                for cb in callbacks:
+                    cb.on_step_end(step, metrics, self)
+                if self.stop_training:
+                    break
+            epoch_host = jax.device_get(epoch_metrics)
+            logs = {k: float(np.mean(v)) for k, v in epoch_host.items()}
+            logs["epoch_seconds"] = time.perf_counter() - epoch_start
+            if validation_data is not None:
+                val = self.evaluate(validation_data)
+                logs.update({f"val_{k}": v for k, v in val.items()})
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs, self)
+        for cb in callbacks:
+            cb.on_train_end(self)
+        return history
+
+    def evaluate(self, data: Callable[[], Iterable]) -> Dict[str, float]:
+        metrics_acc: Dict[str, list] = {}
+        for batch in data():
+            batch = train_lib.shard_batch(batch, self.mesh, self.rules)
+            with self._mesh_context():
+                metrics = self._eval_step(self.state, batch)
+            for key, value in metrics.items():
+                metrics_acc.setdefault(key, []).append(value)
+        host = jax.device_get(metrics_acc)
+        return {k: float(np.mean(v)) for k, v in host.items()}
+
+    def _mesh_context(self):
+        import contextlib
+
+        return self.mesh if self.mesh is not None else contextlib.nullcontext()

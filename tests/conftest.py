@@ -7,10 +7,21 @@ cloud_fit/tests/unit/remote_test.py:76-82).
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the session env pins JAX_PLATFORMS to the real TPU tunnel;
+# tests always run on the virtual CPU platform.  jax snapshots JAX_PLATFORMS
+# into its config at import time and pytest plugins may import jax before
+# this conftest, so update the live config too (the backend itself
+# initializes lazily, at first device use inside the tests).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

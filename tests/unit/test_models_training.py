@@ -1,0 +1,345 @@
+"""Model + training-stack tests on the virtual 8-device mesh.
+
+Covers: every model trains (loss decreases), ring attention matches dense
+attention exactly, MoE/pp/ep configurations compile and run, Trainer
+callback protocol, checkpoint round-trip.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from cloud_tpu import parallel
+from cloud_tpu.models import bert, layers, mnist, moe, resnet, transformer
+from cloud_tpu.parallel.ring_attention import ring_attention
+from cloud_tpu.training import (
+    Trainer,
+    create_sharded_state,
+    data,
+    make_train_step,
+)
+from cloud_tpu.training import train as train_lib
+from jax.sharding import PartitionSpec
+
+
+def make_trainer(cfg, mesh, rules=parallel.DEFAULT_RULES, lr=1e-3):
+    return Trainer(
+        functools.partial(transformer.loss_fn, config=cfg, mesh=mesh, rules=rules),
+        optax.adamw(lr),
+        init_fn=functools.partial(transformer.init, config=cfg),
+        mesh=mesh,
+        logical_axes=transformer.param_logical_axes(cfg),
+        rules=rules,
+    )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense_attention(self, causal):
+        """Ring attention over 4 sequence shards == single-device attention."""
+        mesh = parallel.MeshSpec({"sp": 4}).build(jax.devices()[:4])
+        b, t, h, d = 2, 32, 4, 16
+        rng = jax.random.PRNGKey(0)
+        rq, rk, rv = jax.random.split(rng, 3)
+        q = jax.random.normal(rq, (b, t, h, d), jnp.float32)
+        k = jax.random.normal(rk, (b, t, h, d), jnp.float32)
+        v = jax.random.normal(rv, (b, t, h, d), jnp.float32)
+
+        expected = layers.causal_attention(q, k, v, causal=causal)
+
+        spec = PartitionSpec(None, "sp", None, None)
+        ring = jax.jit(
+            jax.shard_map(
+                functools.partial(ring_attention, axis="sp", causal=causal),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+        )
+        np.testing.assert_allclose(
+            np.asarray(ring(q, k, v)), np.asarray(expected), atol=2e-5
+        )
+
+
+class TestTransformer:
+    def test_forward_shapes(self):
+        cfg = transformer.TINY
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits, aux = transformer.apply(params, tokens, cfg)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self):
+        """Future tokens must not affect past logits."""
+        cfg = transformer.TINY
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        t1 = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+        t2 = t1.at[:, -1].set(99)  # change only the last token
+        l1, _ = transformer.apply(params, t1, cfg)
+        l2, _ = transformer.apply(params, t2, cfg)
+        np.testing.assert_allclose(
+            np.asarray(l1[:, :-1]), np.asarray(l2[:, :-1]), atol=1e-5
+        )
+
+    def test_train_on_multi_axis_mesh_loss_decreases(self):
+        mesh = parallel.MeshSpec({"fsdp": 2, "sp": 2, "tp": 2}).build()
+        cfg = transformer.TINY
+        with parallel.use_mesh(mesh):
+            tr = make_trainer(cfg, mesh)
+            tr.init_state(jax.random.PRNGKey(0))
+            ds = data.synthetic_tokens(
+                vocab_size=cfg.vocab_size, seq_len=32, batch_size=8, num_batches=4
+            )
+            hist = tr.fit(ds, epochs=3)
+        losses = hist.history["loss"]
+        assert losses[-1] < losses[0]
+
+    def test_moe_ep_pp_mesh_trains(self):
+        mesh = parallel.MeshSpec({"pp": 2, "fsdp": 2, "ep": 2}).build()
+        cfg = transformer.TINY.scaled(moe=moe.MoeConfig(num_experts=4, top_k=2))
+        rules = parallel.DEFAULT_RULES.extended(layers="pp")
+        with parallel.use_mesh(mesh):
+            tr = make_trainer(cfg, mesh, rules=rules)
+            tr.init_state(jax.random.PRNGKey(0))
+            ds = data.synthetic_tokens(
+                vocab_size=cfg.vocab_size, seq_len=32, batch_size=8, num_batches=2
+            )
+            hist = tr.fit(ds, epochs=2)
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+        assert hist.history["aux"][0] > 0.0  # MoE balance loss active
+
+    def test_params_actually_sharded(self):
+        mesh = parallel.MeshSpec({"fsdp": 4, "tp": 2}).build()
+        cfg = transformer.TINY
+        state = create_sharded_state(
+            jax.random.PRNGKey(0),
+            functools.partial(transformer.init, config=cfg),
+            optax.adamw(1e-3),
+            mesh,
+            logical_axes=transformer.param_logical_axes(cfg),
+        )
+        # attention q kernel: [layers, embed(fsdp), heads(tp)]
+        q_kernel = state.params["layers"]["att"]["q"]["kernel"]
+        assert len(q_kernel.addressable_shards) == 8
+        shard = q_kernel.addressable_shards[0].data
+        assert shard.shape[1] == cfg.dim // 4
+        assert shard.shape[2] == (cfg.num_heads * cfg.head_dim) // 2
+        # optimizer state inherits the same layout
+        mu = None
+        for leaf in jax.tree_util.tree_leaves(state.opt_state):
+            if leaf.shape == q_kernel.shape:
+                mu = leaf
+                break
+        assert mu is not None
+        assert mu.addressable_shards[0].data.shape == shard.shape
+
+
+class TestMoeUnit:
+    def test_top1_routing_capacity(self):
+        cfg = moe.MoeConfig(num_experts=2, top_k=1, capacity_factor=2.0)
+        params, _ = moe.moe_mlp_init(jax.random.PRNGKey(0), 8, 16, cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 8))
+        out, aux = moe.moe_mlp_apply(params, x, cfg)
+        assert out.shape == x.shape
+        assert np.isfinite(np.asarray(out)).all()
+        assert float(aux) >= 0.0
+
+
+class TestMnist:
+    def test_trains_to_high_accuracy_on_separable_data(self):
+        rng = np.random.default_rng(0)
+        n = 512
+        labels = rng.integers(0, 10, n)
+        images = np.zeros((n, 28, 28), np.float32)
+        images[np.arange(n), labels, labels] = 1.0  # trivially separable
+        mesh = parallel.MeshSpec({"dp": 8}).build()
+        cfg = mnist.MnistConfig()
+        tr = Trainer(
+            functools.partial(mnist.loss_fn, config=cfg),
+            optax.adam(1e-2),
+            init_fn=functools.partial(mnist.init, config=cfg),
+            mesh=mesh,
+            logical_axes=mnist.param_logical_axes(cfg),
+        )
+        tr.init_state(jax.random.PRNGKey(0))
+        ds = data.ArrayDataset(
+            {"image": images, "label": labels}, batch_size=64, shuffle=True
+        )
+        hist = tr.fit(ds, epochs=5)
+        assert hist.history["accuracy"][-1] > 0.9
+
+
+class TestResnet:
+    def test_forward_and_one_step(self):
+        cfg = resnet.RESNET50_CIFAR
+        params = resnet.init(jax.random.PRNGKey(0), cfg)
+        images = jnp.zeros((2, 32, 32, 3), jnp.float32)
+        logits = resnet.apply(params, images, cfg)
+        assert logits.shape == (2, 10)
+        step = make_train_step(
+            functools.partial(resnet.loss_fn, config=cfg), optax.sgd(0.1)
+        )
+        state = create_sharded_state(
+            jax.random.PRNGKey(0),
+            functools.partial(resnet.init, config=cfg),
+            optax.sgd(0.1),
+            mesh=None,
+        )
+        batch = {
+            "image": np.random.default_rng(0).normal(size=(4, 32, 32, 3)).astype(np.float32),
+            "label": np.array([0, 1, 2, 3]),
+        }
+        new_state, metrics = step(state, batch)
+        assert int(new_state.step) == 1
+        assert np.isfinite(metrics["loss"])
+
+
+class TestBert:
+    def test_bidirectional_and_trains(self):
+        cfg = bert.TINY
+        mesh = parallel.MeshSpec({"fsdp": 4, "tp": 2}).build()
+        params = bert.init(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.array([[1, 2, 3, 4]], jnp.int32)
+        # changing the LAST token changes the FIRST position's encoding
+        enc1 = bert.encode(params, tokens, cfg)
+        enc2 = bert.encode(params, tokens.at[:, -1].set(9), cfg)
+        assert not np.allclose(np.asarray(enc1[:, 0]), np.asarray(enc2[:, 0]))
+
+        rng = np.random.default_rng(0)
+        n = 64
+        labels = rng.integers(0, 2, n)
+        tokens = np.where(
+            labels[:, None] == 1,
+            rng.integers(256, 512, (n, 16)),
+            rng.integers(1, 256, (n, 16)),
+        ).astype(np.int32)
+        tr = Trainer(
+            functools.partial(bert.loss_fn, cfg=cfg),
+            optax.adam(1e-3),
+            init_fn=functools.partial(bert.init, cfg=cfg),
+            mesh=mesh,
+            logical_axes=bert.param_logical_axes(cfg),
+        )
+        with parallel.use_mesh(mesh):
+            tr.init_state(jax.random.PRNGKey(0))
+            ds = data.ArrayDataset(
+                {"tokens": tokens, "label": labels}, batch_size=16, shuffle=True
+            )
+            hist = tr.fit(ds, epochs=4)
+        assert hist.history["accuracy"][-1] > 0.8
+
+
+class TestTrainerProtocol:
+    def test_callbacks_and_validation(self):
+        events = []
+
+        from cloud_tpu.training.trainer import Callback
+
+        class Rec(Callback):
+            def on_train_begin(self, trainer):
+                events.append("train_begin")
+
+            def on_epoch_end(self, epoch, logs, trainer):
+                events.append(("epoch_end", epoch, "val_loss" in logs))
+
+            def on_train_end(self, trainer):
+                events.append("train_end")
+
+        cfg = mnist.MnistConfig(hidden_dim=32)
+        tr = Trainer(
+            functools.partial(mnist.loss_fn, config=cfg),
+            optax.adam(1e-3),
+            init_fn=functools.partial(mnist.init, config=cfg),
+        )
+        tr.init_state(jax.random.PRNGKey(0))
+        arrays = {
+            "image": np.zeros((32, 784), np.float32),
+            "label": np.zeros((32,), np.int64),
+        }
+        ds = data.ArrayDataset(arrays, batch_size=16)
+        tr.fit(ds, epochs=2, validation_data=ds, callbacks=[Rec()])
+        assert events[0] == "train_begin"
+        assert events[-1] == "train_end"
+        assert ("epoch_end", 0, True) in events
+
+    def test_early_stop_via_stop_training(self):
+        from cloud_tpu.training.trainer import LambdaCallback
+
+        def stop(step, logs, trainer):
+            trainer.stop_training = True
+
+        cfg = mnist.MnistConfig(hidden_dim=32)
+        tr = Trainer(
+            functools.partial(mnist.loss_fn, config=cfg),
+            optax.adam(1e-3),
+            init_fn=functools.partial(mnist.init, config=cfg),
+        )
+        tr.init_state(jax.random.PRNGKey(0))
+        ds = data.ArrayDataset(
+            {"image": np.zeros((64, 784), np.float32),
+             "label": np.zeros((64,), np.int64)},
+            batch_size=8,
+        )
+        tr.fit(ds, epochs=3)
+        # stop after first step of first epoch
+        tr2 = Trainer(
+            functools.partial(mnist.loss_fn, config=cfg),
+            optax.adam(1e-3),
+            init_fn=functools.partial(mnist.init, config=cfg),
+        )
+        tr2.init_state(jax.random.PRNGKey(0))
+        tr2.fit(ds, epochs=3, callbacks=[LambdaCallback(on_step_end=stop)])
+        assert int(tr2.state.step) == 1
+
+
+class TestCheckpoint:
+    def test_save_restore_round_trip(self, tmp_path):
+        from cloud_tpu.training.checkpoint import CheckpointManager
+
+        cfg = mnist.MnistConfig(hidden_dim=16)
+        state = create_sharded_state(
+            jax.random.PRNGKey(0),
+            functools.partial(mnist.init, config=cfg),
+            optax.adam(1e-3),
+            mesh=None,
+        )
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(0, state)
+        mgr.wait()
+        restored = mgr.restore(0, template=jax.tree_util.tree_map(np.asarray, state))
+        np.testing.assert_allclose(
+            np.asarray(state.params["hidden"]["kernel"]),
+            restored.params["hidden"]["kernel"],
+        )
+        mgr.close()
+
+
+class TestArrayDataset:
+    def test_batching_and_reiteration(self):
+        ds = data.ArrayDataset(
+            {"x": np.arange(10)}, batch_size=3, drop_remainder=True
+        )
+        batches = list(ds())
+        assert len(batches) == 3 == len(ds)
+        assert all(b["x"].shape == (3,) for b in batches)
+        # re-iterable
+        assert len(list(ds())) == 3
+
+    def test_shuffle_determinism_per_epoch(self):
+        ds = data.ArrayDataset(
+            {"x": np.arange(100)}, batch_size=10, shuffle=True, seed=1
+        )
+        first = np.concatenate([b["x"] for b in ds()])
+        second = np.concatenate([b["x"] for b in ds()])
+        assert not np.array_equal(first, second)  # reshuffles each epoch
+        assert set(first) == set(range(100))
+
+    def test_unequal_lengths_rejected(self):
+        with pytest.raises(ValueError, match="Unequal"):
+            data.ArrayDataset({"a": np.zeros(3), "b": np.zeros(4)}, batch_size=1)

@@ -1,0 +1,200 @@
+"""Mesh/planner/sharding tests on the 8-device virtual CPU platform.
+
+Pattern parity: the reference's preprocess_test.py golden-tests the
+auto-strategy decision table (preprocess_test.py:60-157); here the planner's
+decision table is asserted directly, and meshes are actually built.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from cloud_tpu.core import machine_config
+from cloud_tpu import parallel
+from cloud_tpu.parallel import collectives, planner
+
+MC = machine_config.COMMON_MACHINE_CONFIGS
+
+
+class TestMeshSpec:
+    def test_build_canonical_axes(self):
+        spec = parallel.MeshSpec({"dp": 2, "tp": 4})
+        mesh = spec.build()
+        assert mesh.axis_names == parallel.CANONICAL_AXES
+        assert mesh.shape["dp"] == 2
+        assert mesh.shape["tp"] == 4
+        assert mesh.shape["pp"] == 1
+
+    def test_build_rejects_wrong_device_count(self):
+        with pytest.raises(ValueError, match="devices"):
+            parallel.MeshSpec({"dp": 3}).build()
+
+    def test_rejects_unknown_axis(self):
+        with pytest.raises(ValueError, match="Unknown mesh axis"):
+            parallel.MeshSpec({"zz": 2})
+
+    def test_json_round_trip(self):
+        spec = parallel.MeshSpec({"dp": 2, "fsdp": 4}, dcn_sizes={"dp": 2})
+        back = parallel.MeshSpec.from_json(spec.to_json())
+        assert back == spec
+
+    def test_global_mesh_context(self):
+        spec = parallel.MeshSpec({"dp": 8})
+        mesh = spec.build()
+        assert parallel.get_global_mesh() is None
+        with parallel.use_mesh(mesh):
+            assert parallel.get_global_mesh() is mesh
+        assert parallel.get_global_mesh() is None
+
+
+class TestPlanner:
+    """The auto-layout decision table (replaces preprocess.py:124-149)."""
+
+    def test_single_device_plan(self):
+        plan = planner.plan_mesh(num_devices=1)
+        assert plan.spec.num_devices == 1
+        assert plan.spec.nontrivial_axes() == []
+
+    def test_cpu_config_plan(self):
+        plan = planner.plan_mesh(chief_config=MC["CPU"])
+        assert plan.total_chips == 1
+
+    def test_single_host_slice_defaults_to_fsdp(self):
+        # 'TPU' = v5e-8, one host; prefer_fsdp default True.
+        plan = planner.plan_mesh(chief_config=MC["TPU"])
+        assert plan.spec.size("fsdp") == 8
+        assert plan.num_slices == 1
+        assert plan.spec.dcn_axes == ()
+
+    def test_single_host_mirrored_analogue(self):
+        hints = planner.ParallelismHints(prefer_fsdp=False)
+        plan = planner.plan_mesh(chief_config=MC["TPU"], hints=hints)
+        assert plan.spec.size("dp") == 8
+        assert plan.spec.size("fsdp") == 1
+
+    def test_multi_host_slice_shards_over_ici(self):
+        plan = planner.plan_mesh(chief_config=MC["TPU_V5E_32"])
+        assert plan.hosts_per_slice == 8
+        assert plan.spec.size("fsdp") == 32
+
+    def test_multi_slice_puts_dp_on_dcn(self):
+        plan = planner.plan_mesh(chief_config=MC["TPU"], worker_count=3)
+        assert plan.num_slices == 4
+        assert plan.spec.size("dp") == 4
+        assert plan.spec.size("fsdp") == 8
+        assert plan.spec.dcn_axes == ("dp",)
+        assert plan.total_chips == 32
+
+    def test_multi_slice_rejects_unrealizable_dp_pin(self):
+        # dp=1 over 2 slices would force fsdp across DCN; must be rejected.
+        with pytest.raises(ValueError, match="divisible by the slice count"):
+            planner.plan_mesh(
+                chief_config=MC["TPU"], worker_count=1,
+                hints=planner.ParallelismHints(dp=1),
+            )
+
+    def test_model_parallel_hints(self):
+        hints = planner.ParallelismHints(tp=2, sp=2)
+        plan = planner.plan_mesh(num_devices=8, hints=hints)
+        assert plan.spec.size("tp") == 2
+        assert plan.spec.size("sp") == 2
+        assert plan.spec.size("fsdp") == 2
+
+    def test_hints_must_divide(self):
+        with pytest.raises(ValueError, match="divide"):
+            planner.plan_mesh(num_devices=8, hints=planner.ParallelismHints(tp=3))
+
+    def test_inconsistent_dp_fsdp_rejected(self):
+        with pytest.raises(ValueError, match="dp=4"):
+            planner.plan_mesh(
+                num_devices=8, hints=planner.ParallelismHints(dp=4, fsdp=4)
+            )
+
+    def test_plan_json_round_trip(self):
+        plan = planner.plan_mesh(chief_config=MC["TPU"], worker_count=1)
+        back = planner.MeshPlan.from_json(plan.to_json())
+        assert back == plan
+
+    def test_plan_builds_real_mesh(self):
+        plan = planner.plan_mesh(
+            num_devices=8, hints=planner.ParallelismHints(tp=2, fsdp=4)
+        )
+        mesh = plan.build()
+        assert mesh.devices.size == 8
+
+
+class TestShardingRules:
+    def test_default_rules_specs(self):
+        r = parallel.DEFAULT_RULES
+        assert r.spec("batch", "seq", "embed") == PartitionSpec(
+            ("dp", "fsdp"), "sp", "fsdp"
+        )
+        assert r.spec("embed", "mlp") == PartitionSpec("fsdp", "tp")
+        assert r.spec(None, "heads") == PartitionSpec(None, "tp")
+
+    def test_unknown_logical_axis(self):
+        with pytest.raises(KeyError, match="No sharding rule"):
+            parallel.DEFAULT_RULES.spec("bogus")
+
+    def test_extended_overrides(self):
+        r = parallel.DEFAULT_RULES.extended(embed=None)
+        assert r.spec("embed") == PartitionSpec(None)
+        # original unchanged
+        assert parallel.DEFAULT_RULES.spec("embed") == PartitionSpec("fsdp")
+
+    def test_shard_constraint_noop_without_mesh(self):
+        x = jnp.ones((4, 4))
+        assert parallel.shard_constraint(x, "batch", None) is x
+
+    def test_named_sharding_places_data(self):
+        mesh = parallel.MeshSpec({"dp": 2, "fsdp": 4}).build()
+        sharding = parallel.named_sharding(mesh, "batch", None)
+        x = jax.device_put(np.zeros((16, 3)), sharding)
+        # batch dim sharded over dp*fsdp = 8 devices
+        assert len(x.addressable_shards) == 8
+        assert x.addressable_shards[0].data.shape == (2, 3)
+
+
+class TestCollectives:
+    def test_ring_permute_and_psum_in_shard_map(self):
+        from jax import shard_map
+
+        mesh = parallel.MeshSpec({"sp": 8}).build()
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        def body(x):
+            shifted = collectives.ring_permute(x, "sp", shift=1)
+            total = collectives.all_reduce_sum(x, "sp")
+            return shifted + 0 * total, total
+
+        shifted, total = jax.jit(
+            shard_map(
+                body,
+                mesh=mesh,
+                in_specs=PartitionSpec("sp"),
+                out_specs=(PartitionSpec("sp"), PartitionSpec()),
+            )
+        )(x)
+        # shard i receives shard (i-1)'s value
+        np.testing.assert_allclose(
+            np.asarray(shifted).ravel(), [7, 0, 1, 2, 3, 4, 5, 6]
+        )
+        np.testing.assert_allclose(np.asarray(total), 28.0)
+
+    def test_broadcast_from_root(self):
+        from jax import shard_map
+
+        mesh = parallel.MeshSpec({"dp": 8}).build()
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        out = jax.jit(
+            shard_map(
+                lambda v: collectives.broadcast_from(v, "dp", root=3),
+                mesh=mesh,
+                in_specs=PartitionSpec("dp"),
+                out_specs=PartitionSpec("dp"),
+            )
+        )(x)
+        np.testing.assert_allclose(np.asarray(out).ravel(), [3.0] * 8)
