@@ -1,0 +1,81 @@
+"""Container-side bootstrap: the runtime that replaces generated prologues.
+
+The reference *generated Python text* that picked a tf.distribute strategy
+and exec'd the user script inside the remote container
+(preprocess.py:117-164).  Here the container ENTRYPOINT is this module:
+
+    python -m cloud_tpu.core.bootstrap \
+        --entry-point=train.py --mesh-plan='{"sizes": ...}'
+
+On every host it (1) marks the process as remote (the ``remote()``
+contract), (2) initializes ``jax.distributed`` from the env contract
+(deploy.py writes it into the TPU-VM startup script), (3) builds the
+planned mesh and installs it as the global mesh, then (4) runs the user
+script under ``__main__`` semantics.  The same script that called
+``run()`` locally re-enters here, hits the ``remote()`` guard, and falls
+through to its training code — the "same script runs both places"
+contract (reference run.py:31-33).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import runpy
+import sys
+
+logger = logging.getLogger(__name__)
+
+ENV_RUNNING_REMOTELY = "CLOUD_TPU_RUNNING_REMOTELY"
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--entry-point", required=True,
+                        help=".py or .ipynb to execute under the mesh")
+    parser.add_argument("--mesh-plan", default=None,
+                        help="MeshPlan JSON (omit: plan over local devices)")
+    parser.add_argument("--distribution-strategy", default="auto",
+                        choices=["auto", "none"],
+                        help="'none': user script owns mesh construction")
+    parser.add_argument("entry_point_args", nargs="*",
+                        help="argv passed through to the entry point")
+    args = parser.parse_args(argv)
+
+    os.environ[ENV_RUNNING_REMOTELY] = "1"
+
+    from cloud_tpu.parallel import distributed
+
+    distributed.initialize_from_env()
+
+    entry_point = args.entry_point
+    if entry_point.endswith(".ipynb"):
+        from cloud_tpu.core import notebook
+
+        entry_point = notebook.notebook_to_script(entry_point)
+
+    sys.argv = [entry_point] + list(args.entry_point_args)
+
+    if args.distribution_strategy == "none":
+        # User-owned parallelism (reference validate.py:117-124 None path).
+        runpy.run_path(entry_point, run_name="__main__")
+        return
+
+    import jax
+
+    from cloud_tpu.parallel import mesh as mesh_lib
+    from cloud_tpu.parallel import planner
+
+    if args.mesh_plan:
+        plan = planner.MeshPlan.from_json(args.mesh_plan)
+    else:
+        plan = planner.plan_mesh(num_devices=len(jax.devices()))
+    logger.info("bootstrap: %s", plan.description)
+    mesh = plan.build()
+    with mesh_lib.use_mesh(mesh):
+        runpy.run_path(entry_point, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

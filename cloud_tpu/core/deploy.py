@@ -1,0 +1,212 @@
+"""Deployment: emit and submit Cloud TPU VM job specs.
+
+Reference analogue: ``src/python/tensorflow_cloud/core/deploy.py`` (CAIP
+trainingInput :109-161, submit :82-88, console URL :170-184, log streaming
+:187-211, job id :214-218).  The TPU-native job is not a CAIP GPU cluster:
+each worker role becomes a **TPU VM node** (tpu.googleapis.com v2) whose
+startup script launches the training container on every host of the slice
+with the ``jax.distributed`` env contract filled in — replacing both CAIP's
+``TF_CONFIG`` injection and the reference's ``cloud_tpu`` sidecar worker.
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import uuid
+from typing import Dict, List, Optional
+
+from cloud_tpu.core import gcp, machine_config
+from cloud_tpu.parallel import planner
+from cloud_tpu.utils import api_client
+
+logger = logging.getLogger(__name__)
+
+_TPU_API = "https://tpu.googleapis.com/v2"
+
+
+def _job_id() -> str:
+    """cloud_tpu_train_<uuid> (reference deploy.py:214-218)."""
+    return f"cloud-tpu-train-{uuid.uuid4().hex[:8]}"
+
+
+def startup_script(
+    image_uri: str,
+    *,
+    coordinator_address: str,
+    num_processes: int,
+    process_id_base: int,
+) -> str:
+    """TPU-VM startup script: pull + run the training container on each host.
+
+    ``process_id_base`` is the rank of this node's host 0; TPU VM metadata
+    exposes the within-node worker index as ``agent-worker-number``, so the
+    global rank is base + local index.  This replaces the reference's
+    resolver-wait prologue (preprocess.py:215-262) — topology is fully
+    determined before boot.
+    """
+    return "\n".join(
+        [
+            "#! /bin/bash",
+            "set -ex",
+            'LOCAL_ID=$(curl -sf -H "Metadata-Flavor: Google" '
+            '"http://metadata.google.internal/computeMetadata/v1/instance/'
+            'attributes/agent-worker-number" || echo 0)',
+            f"docker pull {image_uri}",
+            "docker run --privileged --net=host \\",
+            f"  -e CLOUD_TPU_COORDINATOR={coordinator_address} \\",
+            f"  -e CLOUD_TPU_NUM_PROCESSES={num_processes} \\",
+            f"  -e CLOUD_TPU_PROCESS_ID=$(({process_id_base} + LOCAL_ID)) \\",
+            f"  {image_uri}",
+        ]
+    )
+
+
+def build_node_request(
+    image_uri: str,
+    config: machine_config.MachineConfig,
+    *,
+    coordinator_address: str,
+    num_processes: int,
+    process_id_base: int,
+    job_labels: Optional[Dict[str, str]] = None,
+    service_account: Optional[str] = None,
+) -> dict:
+    """The TPU v2 API Node body for one slice (golden-tested)."""
+    topo = config.tpu_topology()
+    node: dict = {
+        "acceleratorType": topo.accelerator_type,
+        "runtimeVersion": gcp.TPU_RUNTIME_VERSIONS[config.accelerator_type],
+        "metadata": {
+            "startup-script": startup_script(
+                image_uri,
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id_base=process_id_base,
+            )
+        },
+        "labels": dict(job_labels or {}),
+    }
+    if service_account:
+        node["serviceAccount"] = {"email": service_account}
+    return node
+
+
+def build_job_request(
+    image_uri: str,
+    chief_config: machine_config.MachineConfig,
+    worker_count: int,
+    plan: planner.MeshPlan,
+    *,
+    job_id: Optional[str] = None,
+    job_labels: Optional[Dict[str, str]] = None,
+    service_account: Optional[str] = None,
+) -> dict:
+    """All node bodies for a (multi-)slice job, keyed by node id.
+
+    Slice i's hosts get ranks [i * hosts_per_slice, (i+1) * hosts_per_slice);
+    the coordinator is slice 0 host 0, reachable by node DNS name.
+    """
+    job_id = job_id or _job_id()
+    num_slices = worker_count + 1
+    hosts_per_slice = plan.hosts_per_slice
+    num_processes = num_slices * hosts_per_slice
+    coordinator = f"{job_id}-0-w0:8476"
+    nodes = {}
+    for i in range(num_slices):
+        nodes[f"{job_id}-{i}"] = build_node_request(
+            image_uri,
+            chief_config,
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id_base=i * hosts_per_slice,
+            job_labels={**(job_labels or {}), "cloud_tpu_job": job_id},
+            service_account=service_account,
+        )
+    return {"job_id": job_id, "nodes": nodes}
+
+
+def deploy_job(
+    image_uri: str,
+    chief_config: machine_config.MachineConfig,
+    worker_count: int,
+    plan: planner.MeshPlan,
+    *,
+    project: Optional[str] = None,
+    zone: Optional[str] = None,
+    job_labels: Optional[Dict[str, str]] = None,
+    service_account: Optional[str] = None,
+    session: Optional[api_client.GcpApiSession] = None,
+    stream_logs: bool = False,
+    request: Optional[dict] = None,
+) -> dict:
+    """Create the TPU nodes for the job; returns job info incl. console URL.
+
+    ``request`` may carry a prebuilt ``build_job_request`` result (run()
+    builds one for its report; passing it here guarantees the submitted
+    nodes are exactly the reported ones).
+    """
+    if not chief_config.is_tpu():
+        raise NotImplementedError(
+            "deploy_job launches Cloud TPU jobs; CPU-only/chief-off-slice "
+            "jobs are not yet supported. "
+            + (
+                machine_config.gpu_migration_hint(chief_config)
+                if chief_config.is_gpu()
+                else ""
+            )
+        )
+    project = project or gcp.get_project_name()
+    zone = zone or gcp.get_zone(chief_config)
+    session = session or api_client.default_session()
+    if request is None:
+        request = build_job_request(
+            image_uri, chief_config, worker_count, plan,
+            job_labels=job_labels, service_account=service_account,
+        )
+    parent = f"projects/{project}/locations/{zone}"
+    for node_id, body in request["nodes"].items():
+        session.post(
+            f"{_TPU_API}/{parent}/nodes", body=body, params={"nodeId": node_id}
+        )
+        logger.info("created TPU node %s (%s)", node_id, body["acceleratorType"])
+    job_id = request["job_id"]
+    console_url = (
+        f"https://console.cloud.google.com/compute/tpus?project={project}"
+    )
+    print(f"Job submitted: {job_id}")
+    print(f"Your TPU nodes are visible at: {console_url}")
+    if stream_logs:
+        _stream_logs(job_id, project, zone)
+    return {
+        "job_id": job_id,
+        "nodes": list(request["nodes"]),
+        "project": project,
+        "zone": zone,
+        "console_url": console_url,
+    }
+
+
+def delete_job(job_info: dict,
+               session: Optional[api_client.GcpApiSession] = None) -> None:
+    """Tear the job's TPU nodes down (the lifecycle the reference delegated
+    to CAIP — SURVEY.md §7 hard parts)."""
+    session = session or api_client.default_session()
+    parent = f"projects/{job_info['project']}/locations/{job_info['zone']}"
+    for node_id in job_info["nodes"]:
+        session.delete(f"{_TPU_API}/{parent}/nodes/{node_id}")
+        logger.info("deleted TPU node %s", node_id)
+
+
+def _stream_logs(job_id: str, project: str, zone: str) -> None:
+    """Stream node logs via gcloud (reference shelled out the same way,
+    deploy.py:187-211)."""
+    argv = [
+        "gcloud", "logging", "read",
+        f'resource.type="tpu_worker" AND labels.cloud_tpu_job="{job_id}"',
+        "--project", project, "--format", "value(textPayload)",
+    ]
+    try:
+        subprocess.run(argv, check=False)
+    except FileNotFoundError:
+        logger.warning("gcloud not installed; skipping log streaming")

@@ -1,0 +1,49 @@
+"""Notebook handling: detection and .ipynb -> .py conversion.
+
+Reference analogue: ``run.py:249-263`` (_called_from_notebook IPython
+probe) and ``preprocess.py:169-187`` (nbconvert + magic-stripping).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+
+#: Shell escapes / magics / comments — nbconvert rewrites ``!cmd`` and
+#: ``%magic`` into ``get_ipython().…`` calls, so both raw and converted
+#: forms are stripped (reference preprocess.py:181-187 stripped the raw
+#: forms only because it converted by hand).
+_MAGIC_LINE = re.compile(r"^\s*(!|%|#|get_ipython\(\))")
+
+
+def called_from_notebook() -> bool:
+    """True when the current process is an IPython/Colab kernel."""
+    try:
+        import IPython
+
+        shell = IPython.get_ipython()
+        if shell is None:
+            return False
+        return shell.__class__.__name__ in (
+            "ZMQInteractiveShell",  # jupyter
+            "Shell",  # colab
+        )
+    except ImportError:
+        return False
+
+
+def notebook_to_script(notebook_path: str, output_dir: str | None = None) -> str:
+    """Convert an .ipynb to a runnable .py, stripping shell/magic/comment
+    lines (reference preprocess.py:181-187), and return the script path."""
+    from nbconvert import PythonExporter
+
+    exporter = PythonExporter()
+    source, _ = exporter.from_filename(notebook_path)
+    lines = [ln for ln in source.splitlines() if not _MAGIC_LINE.match(ln)]
+    output_dir = output_dir or tempfile.mkdtemp(prefix="cloud_tpu_nb_")
+    base = os.path.splitext(os.path.basename(notebook_path))[0]
+    script_path = os.path.join(output_dir, base + ".py")
+    with open(script_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return script_path

@@ -1,0 +1,426 @@
+"""Launcher pipeline tests: validate / containerize / deploy / run / bootstrap.
+
+Pattern parity with the reference suite (SURVEY.md §4): golden artifacts
+(Dockerfiles, node request dicts — like containerize_test.py/deploy_test.py),
+fakes injected at every network seam, and the bootstrap contract exercised
+in a real subprocess (the analogue of remote_test.py faking TF_CONFIG).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from cloud_tpu.core import (
+    containerize,
+    deploy,
+    machine_config,
+    notebook,
+    run as run_lib,
+    validate as validate_lib,
+)
+from cloud_tpu.parallel import planner
+
+MC = machine_config.COMMON_MACHINE_CONFIGS
+TPU = MC["TPU"]
+CPU = MC["CPU"]
+
+
+def base_validate_kwargs(**overrides):
+    kw = dict(
+        entry_point=None,
+        requirements_txt=None,
+        distribution_strategy="auto",
+        chief_config=TPU,
+        worker_config=None,
+        worker_count=0,
+        entry_point_args=None,
+        stream_logs=False,
+        docker_image_build_bucket=None,
+        called_from_notebook=False,
+    )
+    kw.update(overrides)
+    return kw
+
+
+class TestValidate:
+    def test_defaults_pass(self):
+        validate_lib.validate(**base_validate_kwargs())
+
+    def test_missing_entry_point(self):
+        with pytest.raises(ValueError, match="not found"):
+            validate_lib.validate(
+                **base_validate_kwargs(entry_point="/nope/missing.py")
+            )
+
+    def test_bad_suffix(self, tmp_path):
+        bad = tmp_path / "train.sh"
+        bad.write_text("echo hi")
+        with pytest.raises(ValueError, match="must be one of"):
+            validate_lib.validate(**base_validate_kwargs(entry_point=str(bad)))
+
+    def test_bad_strategy(self):
+        with pytest.raises(ValueError, match="distribution_strategy"):
+            validate_lib.validate(
+                **base_validate_kwargs(distribution_strategy="mirrored")
+            )
+
+    def test_gpu_chief_rejected_with_hint(self):
+        with pytest.raises(NotImplementedError, match="Nearest TPU equivalent"):
+            validate_lib.validate(**base_validate_kwargs(chief_config=MC["T4_1X"]))
+
+    def test_worker_requires_config(self):
+        with pytest.raises(ValueError, match="worker_config"):
+            validate_lib.validate(**base_validate_kwargs(worker_count=2))
+
+    def test_heterogeneous_slices_rejected(self):
+        with pytest.raises(ValueError, match="homogeneous"):
+            validate_lib.validate(
+                **base_validate_kwargs(
+                    worker_count=1, worker_config=MC["TPU_V5E_16"]
+                )
+            )
+
+    def test_notebook_requires_bucket(self):
+        with pytest.raises(ValueError, match="docker_image_build_bucket"):
+            validate_lib.validate(
+                **base_validate_kwargs(called_from_notebook=True)
+            )
+
+    def test_bad_entry_point_args(self):
+        with pytest.raises(ValueError, match="entry_point_args"):
+            validate_lib.validate(
+                **base_validate_kwargs(entry_point_args=[1, 2])
+            )
+
+
+class TestDockerfile:
+    def test_tpu_dockerfile_golden(self):
+        text = containerize.make_dockerfile(
+            "train.py", TPU, requirements_name="requirements.txt",
+        )
+        assert text.splitlines() == [
+            "FROM python:3.11-slim",
+            "WORKDIR /app",
+            "RUN pip install --no-cache-dir 'jax[tpu]' -f "
+            "https://storage.googleapis.com/jax-releases/libtpu_releases.html",
+            "COPY requirements.txt /app/requirements.txt",
+            "RUN pip install --no-cache-dir -r /app/requirements.txt",
+            "COPY . /app",
+            'ENV PYTHONPATH="/app:${PYTHONPATH}"',
+            'ENTRYPOINT ["python", "-m", "cloud_tpu.core.bootstrap", '
+            '"--entry-point=train.py", "--distribution-strategy=auto"]',
+        ]
+
+    def test_entrypoint_carries_plan_and_args(self):
+        text = containerize.make_dockerfile(
+            "train.py", TPU, mesh_plan_json='{"s": 1}',
+            entry_point_args=["--epochs", "3"],
+        )
+        last = text.strip().splitlines()[-1]
+        assert last.startswith("ENTRYPOINT ")
+        # Exec-form array must itself be valid JSON (quotes escaped), and
+        # user args must come after the '--' separator.
+        argv = json.loads(last[len("ENTRYPOINT "):])
+        assert argv[:3] == ["python", "-m", "cloud_tpu.core.bootstrap"]
+        assert '--mesh-plan={"s": 1}' in argv
+        sep = argv.index("--")
+        assert argv[sep + 1:] == ["--epochs", "3"]
+
+    def test_cpu_dockerfile_no_libtpu(self):
+        text = containerize.make_dockerfile("train.py", CPU)
+        assert "libtpu" not in text
+        assert "pip install --no-cache-dir jax" in text
+
+    def test_parent_image_override(self):
+        text = containerize.make_dockerfile(
+            "t.py", TPU, parent_image="my/base:1"
+        )
+        assert text.splitlines()[0] == "FROM my/base:1"
+
+
+class TestBuildContext:
+    def test_context_contains_project_and_framework(self, tmp_path):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "train.py").write_text("print('hi')")
+        (proj / "helper.py").write_text("x = 1")
+        ctx = containerize.build_context(
+            "FROM x", str(proj / "train.py"), None, dst_dir=str(tmp_path / "ctx")
+        )
+        names = set(os.listdir(ctx))
+        assert {"Dockerfile", "train.py", "helper.py", "cloud_tpu"} <= names
+        assert os.path.isfile(os.path.join(ctx, "cloud_tpu", "core", "run.py"))
+
+
+class FakeSession:
+    """Records requests; returns canned responses (reference mocked
+    discovery.build the same way, deploy_test.py:49-84)."""
+
+    def __init__(self, responses=None):
+        self.calls = []
+        self.responses = list(responses or [])
+
+    def _next(self, default):
+        return self.responses.pop(0) if self.responses else default
+
+    def post(self, url, body=None, params=None):
+        self.calls.append(("POST", url, body, params))
+        return self._next({})
+
+    def get(self, url, params=None):
+        self.calls.append(("GET", url, None, params))
+        return self._next({})
+
+    def delete(self, url):
+        self.calls.append(("DELETE", url, None, None))
+        return self._next({})
+
+
+class TestDeploy:
+    def test_node_request_golden(self):
+        plan = planner.plan_mesh(chief_config=TPU)
+        req = deploy.build_job_request(
+            "gcr.io/p/img:1", TPU, 0, plan, job_id="cloud-tpu-train-abc123"
+        )
+        assert list(req["nodes"]) == ["cloud-tpu-train-abc123-0"]
+        node = req["nodes"]["cloud-tpu-train-abc123-0"]
+        assert node["acceleratorType"] == "v5litepod-8"
+        assert node["runtimeVersion"] == "v2-alpha-tpuv5-lite"
+        assert node["labels"]["cloud_tpu_job"] == "cloud-tpu-train-abc123"
+        script = node["metadata"]["startup-script"]
+        assert "docker pull gcr.io/p/img:1" in script
+        assert "CLOUD_TPU_COORDINATOR=cloud-tpu-train-abc123-0-w0:8476" in script
+        assert "CLOUD_TPU_NUM_PROCESSES=1" in script
+
+    def test_multi_slice_ranks(self):
+        plan = planner.plan_mesh(chief_config=MC["TPU_V5E_32"], worker_count=1)
+        req = deploy.build_job_request(
+            "img", MC["TPU_V5E_32"], 1, plan, job_id="j"
+        )
+        assert list(req["nodes"]) == ["j-0", "j-1"]
+        s0 = req["nodes"]["j-0"]["metadata"]["startup-script"]
+        s1 = req["nodes"]["j-1"]["metadata"]["startup-script"]
+        # 2 slices x 8 hosts; slice 1 ranks start at 8
+        assert "CLOUD_TPU_NUM_PROCESSES=16" in s0
+        assert "CLOUD_TPU_PROCESS_ID=$((0 + LOCAL_ID))" in s0
+        assert "CLOUD_TPU_PROCESS_ID=$((8 + LOCAL_ID))" in s1
+
+    def test_deploy_job_posts_nodes(self, monkeypatch):
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "proj")
+        session = FakeSession()
+        plan = planner.plan_mesh(chief_config=TPU)
+        info = deploy.deploy_job(
+            "img", TPU, 0, plan, session=session, zone="us-west4-a"
+        )
+        assert len(session.calls) == 1
+        method, url, body, params = session.calls[0]
+        assert method == "POST"
+        assert url.endswith("projects/proj/locations/us-west4-a/nodes")
+        assert params["nodeId"].startswith("cloud-tpu-train-")
+        assert info["console_url"].endswith("project=proj")
+
+    def test_deploy_rejects_cpu(self):
+        plan = planner.plan_mesh(chief_config=CPU)
+        with pytest.raises(NotImplementedError):
+            deploy.deploy_job("img", CPU, 0, plan, session=FakeSession(),
+                              project="p", zone="z")
+
+    def test_delete_job(self):
+        session = FakeSession()
+        deploy.delete_job(
+            {"project": "p", "zone": "z", "nodes": ["a", "b"]}, session=session
+        )
+        assert [c[0] for c in session.calls] == ["DELETE", "DELETE"]
+
+
+class TestCloudBuilder:
+    def _builder(self, tmp_path, responses):
+        ctx = tmp_path / "ctx"
+        ctx.mkdir()
+        (ctx / "Dockerfile").write_text("FROM x")
+
+        class FakeBlob:
+            def upload_from_string(self, data, content_type=None):
+                self.data = data
+
+        class FakeBucket:
+            def blob(self, name):
+                return FakeBlob()
+
+        class FakeStorage:
+            def bucket(self, name):
+                return FakeBucket()
+
+        session = FakeSession(responses)
+        return containerize.CloudContainerBuilder(
+            "gcr.io/p/i:1", str(ctx), project="p", bucket="b",
+            session=session, storage_client=FakeStorage(), sleeper=lambda s: None,
+        ), session
+
+    def test_build_request_golden(self, tmp_path):
+        builder, _ = self._builder(tmp_path, [])
+        req = builder.build_request("obj.tgz")
+        assert req == {
+            "source": {"storageSource": {"bucket": "b", "object": "obj.tgz"}},
+            "steps": [{
+                "name": "gcr.io/cloud-builders/docker",
+                "args": ["build", "-t", "gcr.io/p/i:1", "."],
+            }],
+            "images": ["gcr.io/p/i:1"],
+        }
+
+    def test_poll_until_success(self, tmp_path):
+        builder, session = self._builder(
+            tmp_path,
+            [
+                {"metadata": {"build": {"id": "bid"}}},
+                {"status": "WORKING"},
+                {"status": "SUCCESS"},
+            ],
+        )
+        assert builder.get_docker_image() == "gcr.io/p/i:1"
+        assert [c[0] for c in session.calls] == ["POST", "GET", "GET"]
+
+    def test_failure_raises(self, tmp_path):
+        builder, _ = self._builder(
+            tmp_path,
+            [{"metadata": {"build": {"id": "bid"}}}, {"status": "FAILURE"}],
+        )
+        with pytest.raises(RuntimeError, match="failed"):
+            builder.get_docker_image()
+
+
+class TestLocalBuilder:
+    def test_records_build_and_push(self, tmp_path):
+        calls = []
+        builder = containerize.LocalContainerBuilder(
+            "img:1", str(tmp_path), runner=calls.append
+        )
+        assert builder.get_docker_image() == "img:1"
+        assert calls[0][:4] == ["docker", "build", "-t", "img:1"]
+        assert calls[1] == ["docker", "push", "img:1"]
+
+
+class TestRun:
+    def test_dry_run_produces_artifacts(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "proj")
+        script = tmp_path / "train.py"
+        script.write_text("print('train')")
+        report = run_lib.run(entry_point=str(script), dry_run=True)
+        assert report.image_uri.startswith("gcr.io/proj/cloud_tpu_train:")
+        assert report.mesh_plan.spec.size("fsdp") == 8  # TPU default = v5e-8
+        assert "jax[tpu]" in report.dockerfile
+        node = next(iter(report.node_requests.values()))
+        assert node["acceleratorType"] == "v5litepod-8"
+        assert not report.submitted
+
+    def test_remote_guard(self, monkeypatch):
+        monkeypatch.setenv(run_lib.ENV_RUNNING_REMOTELY, "1")
+        report = run_lib.run(entry_point="does_not_matter.py")
+        assert not report.submitted
+        assert run_lib.remote()
+
+    def test_unknown_kwargs_rejected(self, tmp_path):
+        script = tmp_path / "t.py"
+        script.write_text("pass")
+        with pytest.raises(TypeError, match="Unknown arguments"):
+            run_lib.run(entry_point=str(script), dry_run=True, bogus=1)
+
+    def test_end_to_end_with_fakes(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "proj")
+        script = tmp_path / "train.py"
+        script.write_text("print('x')")
+
+        class FakeBuilder:
+            def get_docker_image(self):
+                return "gcr.io/proj/built:1"
+
+        session = FakeSession()
+        report = run_lib.run(
+            entry_point=str(script),
+            _builder=FakeBuilder(),
+            _session=session,
+        )
+        assert report.submitted
+        assert report.image_uri == "gcr.io/proj/built:1"
+        assert session.calls  # node creation went through the fake session
+        assert report.job_id.startswith("cloud-tpu-train-")
+
+
+class TestNotebook:
+    def test_conversion_strips_magics(self, tmp_path):
+        nb = {
+            "cells": [
+                {
+                    "cell_type": "code",
+                    "metadata": {},
+                    "outputs": [],
+                    "execution_count": None,
+                    "source": [
+                        "!pip install something\n",
+                        "%matplotlib inline\n",
+                        "x = 1\n",
+                        "print(x)\n",
+                    ],
+                }
+            ],
+            "metadata": {},
+            "nbformat": 4,
+            "nbformat_minor": 5,
+        }
+        path = tmp_path / "nb.ipynb"
+        path.write_text(json.dumps(nb))
+        script = notebook.notebook_to_script(str(path), str(tmp_path))
+        content = open(script).read()
+        assert "pip install" not in content
+        assert "matplotlib" not in content
+        assert "x = 1" in content
+
+
+class TestBootstrap:
+    def test_subprocess_contract(self, tmp_path):
+        """Run the bootstrap ENTRYPOINT for real: env guard set, mesh built
+        and installed, user argv forwarded."""
+        user_script = tmp_path / "user_train.py"
+        user_script.write_text(textwrap.dedent("""
+            import os, sys, json
+            from cloud_tpu.parallel import mesh as mesh_lib
+            from cloud_tpu.core import run as run_lib
+            assert run_lib.remote(), "remote() must be True in the container"
+            mesh = mesh_lib.get_global_mesh()
+            print(json.dumps({
+                "axes": {k: v for k, v in mesh.shape.items()},
+                "argv": sys.argv[1:],
+            }))
+        """))
+        from cloud_tpu.parallel import planner as planner_lib
+
+        plan = planner_lib.plan_mesh(num_devices=8)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env.pop("CLOUD_TPU_RUNNING_REMOTELY", None)
+        # sitecustomize would re-register the axon TPU plugin and override
+        # JAX_PLATFORMS; disable it for the CPU-mesh subprocess.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "cloud_tpu.core.bootstrap",
+                f"--entry-point={user_script}",
+                f"--mesh-plan={plan.to_json()}",
+                "--", "--epochs", "2",
+            ],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+        assert out.returncode == 0, out.stderr
+        payload = json.loads(out.stdout.strip().splitlines()[-1])
+        assert payload["axes"]["fsdp"] == 8
+        assert payload["argv"] == ["--epochs", "2"]
