@@ -1,0 +1,13 @@
+"""cloud_fit: serialize an in-memory training setup and fit it remotely.
+
+Reference analogue: ``experimental/cloud_fit/`` — client serializes model +
+datasets + cloudpickled callbacks to a remote dir and submits a job whose
+container deserializes and runs ``model.fit`` (client.py:45-286,
+remote.py:55-169).  Here the serialized unit is a Trainer spec (loss/
+optimizer/init closures via cloudpickle, arrays via npz, state via Orbax)
+fitted under the planned mesh.
+"""
+
+from cloud_tpu.cloud_fit.client import cloud_fit
+
+__all__ = ["cloud_fit"]

@@ -1,0 +1,140 @@
+"""cloud_fit server side: deserialize assets and fit under the mesh.
+
+Reference analogue: ``cloud_fit/remote.py`` — flags CLI (:40-52), strategy
+scope + asset loading + ``model.fit`` (:68-128), chief-only save with
+non-chief throwaway dirs (:130-156).  Orbax replaces the throwaway-dir
+dance for checkpoints (every process participates in sharded writes); the
+chief-only pattern remains for the single-file outputs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+OUTPUT_DIR = "output"
+
+
+def run(remote_dir: str, *, mesh=None, storage_client=None) -> "object":
+    """Load serialized assets from ``remote_dir`` and run the fit.
+
+    Returns the History.  Called by the generated shim entry point under
+    the bootstrap runtime (mesh already installed globally), or directly
+    in tests with an explicit mesh.
+    """
+    import jax
+
+    from cloud_tpu.cloud_fit import serialization
+    from cloud_tpu.parallel import distributed
+    from cloud_tpu.parallel import mesh as mesh_lib
+    from cloud_tpu.training import Trainer, data as data_lib
+    from cloud_tpu.training.checkpoint import CheckpointManager
+
+    spec, train_arrays, val_arrays, callbacks, fit_kwargs = (
+        serialization.deserialize_assets(remote_dir,
+                                         storage_client=storage_client)
+    )
+    mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
+
+    batch_size = fit_kwargs.pop("batch_size", 32)
+    train_ds = data_lib.ArrayDataset(train_arrays, batch_size, shuffle=True)
+    val_ds = (
+        data_lib.ArrayDataset(val_arrays, batch_size) if val_arrays else None
+    )
+
+    trainer = Trainer(
+        spec.loss_fn,
+        spec.optimizer,
+        init_fn=spec.init_fn,
+        mesh=mesh,
+        logical_axes=spec.logical_axes,
+        rules=spec.rules or _default_rules(),
+    )
+    # Init first: the fresh state doubles as the Orbax restore template
+    # (checkpoint/resume — SURVEY.md §5 aux subsystems).
+    trainer.init_state(jax.random.PRNGKey(0))
+    _maybe_restore(trainer, _join(remote_dir, "state"))
+    history = trainer.fit(
+        train_ds,
+        validation_data=val_ds,
+        callbacks=callbacks,
+        **fit_kwargs,
+    )
+
+    # Save final state.  Orbax coordinates multi-host writes itself; the
+    # history/metrics file is chief-only (non-chief writes would race —
+    # the concern reference remote.py:130-145 solved with throwaway dirs).
+    output_dir = _join(remote_dir, OUTPUT_DIR)
+    manager = CheckpointManager(_join(output_dir, "checkpoint"))
+    manager.save(int(trainer.state.step), trainer.state)
+    manager.wait()
+    manager.close()
+    if distributed.is_chief():
+        _write_history(output_dir, history, storage_client)
+    else:
+        # Non-chief bookkeeping goes to a throwaway location (parity with
+        # reference remote.py:130-145).
+        with tempfile.TemporaryDirectory() as tmp:
+            _write_history(tmp, history, None)
+    return history
+
+
+def _default_rules():
+    from cloud_tpu.parallel.sharding import DEFAULT_RULES
+
+    return DEFAULT_RULES
+
+
+def _join(base: str, name: str) -> str:
+    if base.startswith("gs://"):
+        return base.rstrip("/") + "/" + name
+    return os.path.join(base, name)
+
+
+def _maybe_restore(trainer, state_dir: str) -> bool:
+    if state_dir.startswith("gs://") or os.path.isdir(state_dir):
+        try:
+            import jax
+            import numpy as np
+
+            from cloud_tpu.training.checkpoint import CheckpointManager
+
+            manager = CheckpointManager(state_dir)
+            if manager.latest_step() is not None:
+                template = jax.tree_util.tree_map(np.asarray, trainer.state)
+                trainer.state = manager.restore(template=template)
+                logger.info("restored checkpoint at step %s",
+                            int(trainer.state.step))
+                return True
+        except Exception:
+            logger.exception("could not restore from %s; starting fresh",
+                             state_dir)
+    return False
+
+
+def _write_history(output_dir: str, history, storage_client) -> None:
+    import json
+
+    from cloud_tpu.cloud_fit import serialization as ser
+
+    ser._write_bytes(
+        _join(output_dir, "history.json"),
+        json.dumps(history.history).encode(),
+        storage_client,
+    )
+
+
+def main(argv: Optional[list] = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--remote-dir", required=True)
+    args = parser.parse_args(argv)
+    run(args.remote_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Native metrics + Cloud Monitoring export.
+
+Reference analogue: ``src/cpp/monitoring/`` (SURVEY.md §2.5) — a C++
+collection registry, an env-gated periodic exporter, an allowlist config,
+and a transport client; here the registry/exporter/allowlist are C++
+(``cpp/``, ctypes-bound with a pure-Python fallback) and the authenticated
+transport is the shared REST session.
+
+Also provides the Trainer integration: ``MetricsCallback`` records
+steps/sec and loss into the registry so the exporter ships real training
+telemetry.
+"""
+
+from cloud_tpu.monitoring.metrics import (
+    backend,
+    counter_inc,
+    distribution_record,
+    gauge_set,
+    reset,
+    snapshot,
+)
+from cloud_tpu.monitoring.exporter import (
+    CloudMonitoringExporter,
+    start_exporter,
+    stop_exporter,
+)
+
+import time as _time
+
+
+class MetricsCallback:
+    """Trainer callback feeding the native registry each step/epoch."""
+
+    def __init__(self, prefix: str = "train"):
+        self.prefix = prefix
+        self._last_step_time = None
+
+    def on_train_begin(self, trainer):
+        self._last_step_time = _time.perf_counter()
+
+    def on_train_end(self, trainer): ...
+    def on_epoch_begin(self, epoch, trainer): ...
+
+    def on_step_end(self, step, logs, trainer):
+        now = _time.perf_counter()
+        if self._last_step_time is not None:
+            distribution_record(
+                f"{self.prefix}/step_seconds", now - self._last_step_time
+            )
+        self._last_step_time = now
+        counter_inc(f"{self.prefix}/steps")
+
+    def on_epoch_end(self, epoch, logs, trainer):
+        for key, value in logs.items():
+            gauge_set(f"{self.prefix}/{key}", float(value))
+
+
+__all__ = [
+    "CloudMonitoringExporter",
+    "MetricsCallback",
+    "backend",
+    "counter_inc",
+    "distribution_record",
+    "gauge_set",
+    "reset",
+    "snapshot",
+    "start_exporter",
+    "stop_exporter",
+]

@@ -1,0 +1,213 @@
+"""Cloud Monitoring transport + the exporter wiring.
+
+Reference analogue: ``stackdriver_client.cc`` — snapshot types -> Cloud
+Monitoring v3 structures (histogram->Distribution :69-98, point by type
+:100-124, ``custom.googleapis.com`` metric prefix :126-136, descriptor
+creation deduped per name :138-183/:105-126), project from env (:38-43).
+The gRPC stub becomes the injectable REST session; the periodic thread
+stays native (``cpp/exporter.cc``) and calls back into ``_sink``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional, Set
+
+from cloud_tpu.monitoring import metrics as metrics_lib
+from cloud_tpu.utils import api_client
+
+logger = logging.getLogger(__name__)
+
+_MONITORING_API = "https://monitoring.googleapis.com/v3"
+METRIC_PREFIX = "custom.googleapis.com/cloud_tpu"
+ENV_PROJECT = "CLOUD_TPU_MONITORING_PROJECT_ID"
+
+#: Exponential bucket bounds matching the native registry: 2^(k-1).
+_BUCKET_GROWTH = 2.0
+
+
+def _now_rfc3339() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class CloudMonitoringExporter:
+    """Converts registry snapshots to CreateTimeSeries requests."""
+
+    def __init__(self, project: Optional[str] = None,
+                 session: Optional[api_client.GcpApiSession] = None):
+        self.project = project or os.environ.get(ENV_PROJECT)
+        if not self.project:
+            raise ValueError(
+                f"Set {ENV_PROJECT} (reference used "
+                "TF_MONITORING_STACKDRIVER_PROJECT_ID the same way)."
+            )
+        self._session = session or api_client.default_session()
+        self._described: Set[str] = set()  # descriptor dedup (:105-126)
+
+    # --- conversion (pure; golden-tested) ---
+
+    def time_series(self, snapshot: dict) -> list:
+        end_time = _now_rfc3339()
+        series = []
+        for name, value in snapshot.get("counters", {}).items():
+            series.append(self._one_series(
+                name, "CUMULATIVE", {"int64Value": str(value)}, end_time
+            ))
+        for name, value in snapshot.get("gauges", {}).items():
+            series.append(self._one_series(
+                name, "GAUGE", {"doubleValue": value}, end_time
+            ))
+        for name, dist in snapshot.get("distributions", {}).items():
+            buckets = dist["buckets"]
+            series.append(self._one_series(
+                name,
+                "CUMULATIVE",
+                {
+                    "distributionValue": {
+                        "count": str(dist["count"]),
+                        "mean": dist["mean"],
+                        "sumOfSquaredDeviation": dist["sum_squared_deviation"],
+                        "bucketOptions": {
+                            "exponentialBuckets": {
+                                "numFiniteBuckets": len(buckets) - 2,
+                                "growthFactor": _BUCKET_GROWTH,
+                                "scale": 1.0,
+                            }
+                        },
+                        "bucketCounts": [str(c) for c in buckets],
+                    }
+                },
+                end_time,
+            ))
+        return series
+
+    def _one_series(self, name, kind, value, end_time):
+        interval = {"endTime": end_time}
+        if kind == "CUMULATIVE":
+            interval["startTime"] = _START_TIME
+        return {
+            "metric": {"type": f"{METRIC_PREFIX}/{name}"},
+            "resource": {"type": "global", "labels": {}},
+            "metricKind": kind,
+            "points": [{"interval": interval, "value": value}],
+        }
+
+    # --- transport ---
+
+    def export(self, snapshot: dict) -> None:
+        series = self.time_series(snapshot)
+        if not series:
+            return
+        self._ensure_descriptors(snapshot)
+        url = f"{_MONITORING_API}/projects/{self.project}/timeSeries"
+        # The API caps 200 series per call.
+        for start in range(0, len(series), 200):
+            self._session.post(
+                url, body={"timeSeries": series[start:start + 200]}
+            )
+
+    def _ensure_descriptors(self, snapshot: dict) -> None:
+        kinds = (
+            [(n, "CUMULATIVE", "INT64") for n in snapshot.get("counters", {})]
+            + [(n, "GAUGE", "DOUBLE") for n in snapshot.get("gauges", {})]
+            + [
+                (n, "CUMULATIVE", "DISTRIBUTION")
+                for n in snapshot.get("distributions", {})
+            ]
+        )
+        url = f"{_MONITORING_API}/projects/{self.project}/metricDescriptors"
+        for name, kind, value_type in kinds:
+            if name in self._described:
+                continue
+            self._session.post(url, body={
+                "type": f"{METRIC_PREFIX}/{name}",
+                "metricKind": kind,
+                "valueType": value_type,
+                "description": f"cloud_tpu framework metric {name}",
+            })
+            self._described.add(name)
+
+
+_START_TIME = _now_rfc3339()  # process start = CUMULATIVE interval start
+
+_sink_keepalive = None  # the ctypes callback must outlive the C thread
+_python_thread: Optional[threading.Thread] = None
+_python_stop = threading.Event()
+
+
+def start_exporter(project: Optional[str] = None, session=None) -> bool:
+    """Start periodic export (env-gated, like REGISTER_TF_METRICS_EXPORTER +
+    TF_MONITORING_STACKDRIVER_EXPORTER_ENABLED, stackdriver_exporter.cc:31-36).
+
+    Returns True if the exporter started.  Uses the native timer thread when
+    the C++ library is live, else a Python thread.
+    """
+    global _sink_keepalive, _python_thread
+    if os.environ.get("CLOUD_TPU_MONITORING_ENABLED", "").lower() not in (
+        "1", "true",
+    ):
+        return False
+    exporter = CloudMonitoringExporter(project=project, session=session)
+
+    def sink_json(payload: str) -> None:
+        try:
+            exporter.export(json.loads(payload))
+        except Exception:
+            logger.exception("metrics export failed")
+
+    if metrics_lib.backend() == "native":
+        lib = metrics_lib._get_registry()._lib  # type: ignore[union-attr]
+        SINK = ctypes.CFUNCTYPE(None, ctypes.c_char_p)
+
+        def c_sink(raw):
+            sink_json(raw.decode())
+
+        _sink_keepalive = SINK(c_sink)
+        lib.ctpu_exporter_set_sink.argtypes = [SINK]
+        lib.ctpu_exporter_set_sink(_sink_keepalive)
+        return bool(lib.ctpu_exporter_start())
+
+    if _python_thread is not None and _python_thread.is_alive():
+        return True  # idempotent, matching Exporter::Start
+    interval = int(os.environ.get("CLOUD_TPU_MONITORING_INTERVAL", "10"))
+    allowlist = {
+        name
+        for name in os.environ.get(
+            "CLOUD_TPU_MONITORING_ALLOWLIST", ""
+        ).split(",")
+        if name
+    }
+    _python_stop.clear()
+
+    def filtered_snapshot() -> dict:
+        snap = metrics_lib.snapshot()
+        if not allowlist:
+            return snap
+        return {
+            group: {k: v for k, v in values.items() if k in allowlist}
+            for group, values in snap.items()
+        }
+
+    def loop():
+        while not _python_stop.wait(interval):
+            sink_json(json.dumps(filtered_snapshot()))
+
+    _python_thread = threading.Thread(target=loop, daemon=True)
+    _python_thread.start()
+    return True
+
+
+def stop_exporter() -> None:
+    global _python_thread
+    if metrics_lib.backend() == "native":
+        lib = metrics_lib._get_registry()._lib  # type: ignore[union-attr]
+        lib.ctpu_exporter_stop()
+    _python_stop.set()
+    if _python_thread is not None:
+        _python_thread.join(timeout=5)
+        _python_thread = None

@@ -1,0 +1,178 @@
+"""Python surface of the native metrics registry (ctypes).
+
+The C++ registry (``cpp/metrics_registry.cc``) is the collection point —
+counters/gauges/distributions recorded from any thread, snapshotted as
+JSON.  When the shared library hasn't been built, a pure-Python registry
+with the identical surface takes over (capability degrades gracefully;
+``backend()`` reports which is live).
+
+Builds on demand: first use attempts ``make`` once (g++ is baked into TPU
+VM images; build cost ~1s, cached as a .so next to the sources).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_CPP_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "libcloud_tpu_monitoring.so")
+
+_NUM_BUCKETS = 24
+
+
+class _PurePythonRegistry:
+    """Fallback with the same semantics as the native registry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._dists: Dict[str, dict] = {}
+
+    def counter_inc(self, name, delta=1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(delta)
+
+    def gauge_set(self, name, value):
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def distribution_record(self, name, value):
+        import math
+
+        with self._lock:
+            d = self._dists.setdefault(
+                name,
+                {
+                    "count": 0,
+                    "mean": 0.0,
+                    "sum_squared_deviation": 0.0,
+                    "buckets": [0] * _NUM_BUCKETS,
+                },
+            )
+            d["count"] += 1
+            delta = value - d["mean"]
+            d["mean"] += delta / d["count"]
+            d["sum_squared_deviation"] += delta * (value - d["mean"])
+            if value < 1.0:
+                idx = 0
+            else:
+                idx = min(1 + int(math.floor(math.log2(value))), _NUM_BUCKETS - 1)
+            d["buckets"][idx] += 1
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "distributions": {
+                    k: {**v, "buckets": list(v["buckets"])}
+                    for k, v in self._dists.items()
+                },
+            }
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._dists.clear()
+
+
+class _NativeRegistry:
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.ctpu_counter_inc.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.ctpu_gauge_set.argtypes = [ctypes.c_char_p, ctypes.c_double]
+        lib.ctpu_distribution_record.argtypes = [
+            ctypes.c_char_p, ctypes.c_double,
+        ]
+        lib.ctpu_metrics_snapshot_json.restype = ctypes.c_void_p
+        lib.ctpu_free.argtypes = [ctypes.c_void_p]
+
+    def counter_inc(self, name, delta=1):
+        self._lib.ctpu_counter_inc(name.encode(), int(delta))
+
+    def gauge_set(self, name, value):
+        self._lib.ctpu_gauge_set(name.encode(), float(value))
+
+    def distribution_record(self, name, value):
+        self._lib.ctpu_distribution_record(name.encode(), float(value))
+
+    def snapshot(self):
+        ptr = self._lib.ctpu_metrics_snapshot_json()
+        try:
+            return json.loads(ctypes.string_at(ptr).decode())
+        finally:
+            self._lib.ctpu_free(ptr)
+
+    def reset(self):
+        self._lib.ctpu_registry_reset()
+
+
+_registry = None
+_registry_lock = threading.Lock()
+
+
+def _build_native() -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(
+                ["make", "-C", _CPP_DIR, "libcloud_tpu_monitoring.so"],
+                check=True, capture_output=True, timeout=120,
+            )
+        except Exception as e:
+            logger.info("native metrics build unavailable (%s); using "
+                        "pure-Python registry", e)
+            return None
+    try:
+        return ctypes.CDLL(_LIB_PATH)
+    except OSError as e:
+        logger.info("could not load %s (%s)", _LIB_PATH, e)
+        return None
+
+
+def _get_registry():
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            lib = _build_native()
+            _registry = (
+                _NativeRegistry(lib) if lib is not None else _PurePythonRegistry()
+            )
+        return _registry
+
+
+def backend() -> str:
+    return (
+        "native" if isinstance(_get_registry(), _NativeRegistry) else "python"
+    )
+
+
+# --- module-level API ---
+
+def counter_inc(name: str, delta: int = 1) -> None:
+    _get_registry().counter_inc(name, delta)
+
+
+def gauge_set(name: str, value: float) -> None:
+    _get_registry().gauge_set(name, value)
+
+
+def distribution_record(name: str, value: float) -> None:
+    _get_registry().distribution_record(name, value)
+
+
+def snapshot() -> dict:
+    return _get_registry().snapshot()
+
+
+def reset() -> None:
+    _get_registry().reset()
