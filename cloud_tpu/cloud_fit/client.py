@@ -44,7 +44,7 @@ def cloud_fit(
     steps_per_epoch, plus ``batch_size`` consumed by the remote runner).
     Returns the RunReport from the launcher pipeline.
     """
-    _validate(trainer_spec, train_data, fit_kwargs)
+    _validate(trainer_spec, train_data, validation_data, fit_kwargs)
     serialization.serialize_assets(
         remote_dir,
         trainer_spec,
@@ -83,30 +83,42 @@ def cloud_fit(
     )
 
 
-def _validate(trainer_spec, train_data, fit_kwargs):
+def _validate(trainer_spec, train_data, validation_data, fit_kwargs):
     if not isinstance(trainer_spec, serialization.TrainerSpec):
         raise ValueError(
             f"trainer_spec must be a TrainerSpec, got {type(trainer_spec)}"
         )
-    if not isinstance(train_data, dict) or not all(
-        isinstance(v, np.ndarray) for v in train_data.values()
+    batch_size = fit_kwargs.get("batch_size", 32)
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+    _validate_dataset("train_data", train_data, batch_size)
+    if validation_data is not None:
+        _validate_dataset("validation_data", validation_data, batch_size)
+
+
+def _validate_dataset(name, data, batch_size):
+    """Catch every remote-side ArrayDataset failure here, before a container
+    is built and a TPU slice provisioned (the remote runner defaults
+    batch_size to 32)."""
+    if not isinstance(data, dict) or not all(
+        isinstance(v, np.ndarray) for v in data.values()
     ):
         # The reference likewise rejected non-serializable dataset forms
         # (generators, client.py:159-160).
         raise ValueError(
-            "train_data must be a dict of numpy arrays (in-memory datasets "
+            f"{name} must be a dict of numpy arrays (in-memory datasets "
             "are the serializable unit; for file-based data use run() with "
             "a training script)."
         )
-    # Catch the remote-side ArrayDataset failure here, before a container
-    # is built and a TPU slice provisioned (the remote runner defaults
-    # batch_size to 32).
-    batch_size = fit_kwargs.get("batch_size", 32)
-    if batch_size <= 0:
-        raise ValueError("batch_size must be positive")
-    n = min(len(v) for v in train_data.values()) if train_data else 0
+    lengths = {k: len(v) for k, v in data.items()}
+    if len(set(lengths.values())) > 1:
+        raise ValueError(
+            f"{name} arrays must all have the same leading dimension, "
+            f"got {lengths}"
+        )
+    n = min(lengths.values()) if lengths else 0
     if batch_size > n:
         raise ValueError(
-            f"batch_size {batch_size} exceeds the dataset size {n}; pass a "
+            f"batch_size {batch_size} exceeds the {name} size {n}; pass a "
             "smaller batch_size to cloud_fit()."
         )

@@ -15,6 +15,8 @@ import os
 import tempfile
 from typing import Optional
 
+from cloud_tpu.cloud_fit.serialization import _join
+
 logger = logging.getLogger(__name__)
 
 OUTPUT_DIR = "output"
@@ -88,12 +90,6 @@ def _default_rules():
     from cloud_tpu.parallel.sharding import DEFAULT_RULES
 
     return DEFAULT_RULES
-
-
-def _join(base: str, name: str) -> str:
-    if base.startswith("gs://"):
-        return base.rstrip("/") + "/" + name
-    return os.path.join(base, name)
 
 
 def _maybe_restore(trainer, state_dir: str) -> bool:

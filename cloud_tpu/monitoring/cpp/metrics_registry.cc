@@ -3,6 +3,7 @@
 #include <cmath>
 #include <cstdlib>
 #include <cstring>
+#include <limits>
 #include <sstream>
 
 namespace cloud_tpu {
@@ -10,7 +11,9 @@ namespace cloud_tpu {
 namespace {
 
 int BucketIndex(double value) {
-  if (value < 1.0) return 0;
+  // Non-finite guard: log2(nan/inf) would yield an out-of-range index.
+  if (value == std::numeric_limits<double>::infinity()) return kNumBuckets - 1;
+  if (!std::isfinite(value) || value < 1.0) return 0;
   int idx = 1 + static_cast<int>(std::floor(std::log2(value)));
   if (idx >= kNumBuckets) idx = kNumBuckets - 1;
   return idx;

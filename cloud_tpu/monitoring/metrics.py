@@ -62,19 +62,33 @@ class _PurePythonRegistry:
             delta = value - d["mean"]
             d["mean"] += delta / d["count"]
             d["sum_squared_deviation"] += delta * (value - d["mean"])
-            if value < 1.0:
+            if value == math.inf:
+                idx = _NUM_BUCKETS - 1
+            elif not math.isfinite(value) or value < 1.0:
                 idx = 0
             else:
                 idx = min(1 + int(math.floor(math.log2(value))), _NUM_BUCKETS - 1)
             d["buckets"][idx] += 1
 
     def snapshot(self):
+        import math
+
+        # JSON has no inf/nan; clamp like the native registry's AppendDouble
+        # so a diverged metric can't poison every downstream export POST.
+        def fin(v):
+            return v if math.isfinite(v) else 0.0
+
         with self._lock:
             return {
                 "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
+                "gauges": {k: fin(v) for k, v in self._gauges.items()},
                 "distributions": {
-                    k: {**v, "buckets": list(v["buckets"])}
+                    k: {
+                        **v,
+                        "mean": fin(v["mean"]),
+                        "sum_squared_deviation": fin(v["sum_squared_deviation"]),
+                        "buckets": list(v["buckets"]),
+                    }
                     for k, v in self._dists.items()
                 },
             }

@@ -64,7 +64,9 @@ class Oracle:
     def end_trial(self, trial: Trial,
                   status: TrialStatus = TrialStatus.COMPLETED) -> None:
         trial.status = status
-        if status == TrialStatus.COMPLETED and trial.measurements:
+        # Early-stopped trials still produced valid objective values.
+        scoreable = status in (TrialStatus.COMPLETED, TrialStatus.STOPPED)
+        if scoreable and trial.measurements:
             values = [
                 m[self.objective.name]
                 for m in trial.measurements
@@ -79,7 +81,8 @@ class Oracle:
     def get_best_trials(self, num_trials: int = 1) -> List[Trial]:
         done = [
             t for t in self.trials.values()
-            if t.status == TrialStatus.COMPLETED and t.score is not None
+            if t.status in (TrialStatus.COMPLETED, TrialStatus.STOPPED)
+            and t.score is not None
         ]
         done.sort(
             key=lambda t: t.score, reverse=self.objective.direction == "max"
@@ -143,7 +146,14 @@ class Tuner:
             except Exception:
                 logger.exception("[%s] trial %s infeasible", self.tuner_id,
                                  trial.trial_id)
-                self.oracle.end_trial(trial, TrialStatus.INFEASIBLE)
+                try:
+                    self.oracle.end_trial(trial, TrialStatus.INFEASIBLE)
+                except Exception:
+                    # One unreportable trial must not abort the whole search.
+                    logger.exception(
+                        "[%s] failed to mark trial %s infeasible",
+                        self.tuner_id, trial.trial_id,
+                    )
                 continue
 
     def run_trial(self, trial: Trial, **fit_kwargs) -> None:
@@ -169,7 +179,14 @@ class Tuner:
         callbacks = list(fit_kwargs.pop("callbacks", []))
         callbacks.append(_Report())
         trainer.fit(callbacks=callbacks, **fit_kwargs)
-        self.oracle.end_trial(trial, TrialStatus.COMPLETED)
+        # update_trial may have transitioned the trial to STOPPED (service
+        # early stop); preserve that instead of overwriting with COMPLETED.
+        final = (
+            TrialStatus.COMPLETED
+            if trial.status == TrialStatus.RUNNING
+            else trial.status
+        )
+        self.oracle.end_trial(trial, final)
 
     def get_best_hyperparameters(self, num_trials: int = 1) -> List[HyperParameters]:
         return [t.hyperparameters for t in self.oracle.get_best_trials(num_trials)]

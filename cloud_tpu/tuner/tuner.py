@@ -105,11 +105,21 @@ class CloudOracle(Oracle):
     def end_trial(self, trial: Trial,
                   status: TrialStatus = TrialStatus.COMPLETED) -> None:
         super().end_trial(trial, status)
-        self.service.complete_trial(
-            trial.trial_id,
-            trial.score,
-            infeasible=status == TrialStatus.INFEASIBLE,
-        )
+        try:
+            self.service.complete_trial(
+                trial.trial_id,
+                trial.score,
+                infeasible=status == TrialStatus.INFEASIBLE,
+            )
+        except Exception:
+            if status != TrialStatus.STOPPED:
+                raise
+            # The service already terminalized an early-stopped trial;
+            # completing it again may be rejected — local state is correct.
+            logger.warning(
+                "complete_trial after early stop rejected for %s",
+                trial.trial_id, exc_info=True,
+            )
 
 
 class CloudTuner(Tuner):

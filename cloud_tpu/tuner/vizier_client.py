@@ -45,6 +45,10 @@ class VizierStudyService:
         self.study_id = study_id
         self._session = session or api_client.default_session()
         self._sleep = sleeper
+        #: Objective metric name; Measurement.Metric entries must carry it or
+        #: the service cannot attribute values to the study objective.
+        #: Learned from study_config at create time, else fetched lazily.
+        self._objective: Optional[str] = None
 
     @property
     def _parent(self) -> str:
@@ -59,6 +63,9 @@ class VizierStudyService:
     def create_or_load_study(self, study_config: dict) -> None:
         """Race-safe create: many workers may start simultaneously
         (reference optimizer_client.py:364-443)."""
+        metrics = study_config.get("metrics") or []
+        if metrics:
+            self._objective = metrics[0].get("metric")
         try:
             self._session.post(
                 f"{_BASE}/{self._parent}/studies",
@@ -110,7 +117,7 @@ class VizierStudyService:
                 body={
                     "measurement": {
                         "stepCount": str(step),
-                        "metrics": [{"value": value}],
+                        "metrics": [self._metric_entry(value)],
                     }
                 },
             )
@@ -140,7 +147,9 @@ class VizierStudyService:
             body = {"trialInfeasible": True, "infeasibleReason": "trial failed"}
         elif final_value is not None:
             body = {
-                "finalMeasurement": {"metrics": [{"value": final_value}]}
+                "finalMeasurement": {
+                    "metrics": [self._metric_entry(final_value)]
+                }
             }
         self._session.post(
             f"{_BASE}/{self._study_path}/trials/{trial_id}:complete", body=body
@@ -154,6 +163,21 @@ class VizierStudyService:
         self._session.delete(f"{_BASE}/{self._study_path}")
 
     # --- internals ---
+
+    def _metric_entry(self, value: float) -> Dict[str, Any]:
+        """Measurement.Metric with the study's objective name attached.
+
+        Workers that loaded (rather than created) the study learn the name
+        by fetching the study config once.
+        """
+        if self._objective is None:
+            study = self._session.get(f"{_BASE}/{self._study_path}")
+            metrics = study.get("studyConfig", {}).get("metrics") or []
+            if metrics:
+                self._objective = metrics[0].get("metric")
+        if self._objective is None:
+            return {"value": value}
+        return {"metric": self._objective, "value": value}
 
     def _poll_operation(self, operation: dict) -> dict:
         """Bounded-backoff LRO polling (reference :294-348)."""

@@ -46,6 +46,23 @@ class TestRegistry:
         assert dist["sum_squared_deviation"] == pytest.approx(8.0)
         assert sum(dist["buckets"]) == 3
 
+    def test_non_finite_values_stay_json_safe(self):
+        # Diverged metrics must not crash the registry (native BucketIndex
+        # guard) nor poison export bodies with invalid-JSON NaN tokens.
+        import json
+
+        for reg in (metrics_lib._get_registry(),
+                    metrics_lib._PurePythonRegistry()):
+            reg.reset() if hasattr(reg, "reset") else None
+            reg.gauge_set("loss", float("nan"))
+            reg.distribution_record("lat", float("nan"))
+            reg.distribution_record("lat", float("inf"))
+            reg.distribution_record("lat", float("-inf"))
+            snap = reg.snapshot()
+            json.dumps(snap, allow_nan=False)  # raises on any nan/inf
+            assert snap["distributions"]["lat"]["count"] == 3
+        monitoring.reset()
+
     def test_pure_python_fallback_equivalence(self):
         py = metrics_lib._PurePythonRegistry()
         py.counter_inc("c", 2)

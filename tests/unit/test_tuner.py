@@ -277,13 +277,39 @@ class TestVizierClient:
         assert svc.should_stop("5") is True
 
     def test_complete_with_final_measurement(self):
-        session = FakeSession([(":complete", {})])
+        # A worker that created the study knows the objective name and must
+        # stamp it on the final measurement (Measurement.Metric requires it).
+        session = FakeSession([("studies", {}), (":complete", {})])
         svc = VizierStudyService("p", "r", "s", session=session,
                                  sleeper=lambda s: None)
+        svc.create_or_load_study(_study_config())
         svc.complete_trial("7", 0.42)
-        _, url, body, _ = session.calls[0]
+        _, url, body, _ = session.calls[-1]
         assert url.endswith("trials/7:complete")
-        assert body == {"finalMeasurement": {"metrics": [{"value": 0.42}]}}
+        assert body == {
+            "finalMeasurement": {
+                "metrics": [{"metric": "loss", "value": 0.42}]
+            }
+        }
+
+    def test_measurement_metric_name_fetched_when_study_loaded(self):
+        # A worker that only loaded the study fetches the objective name
+        # from the study config once, then reuses it.
+        session = FakeSession([
+            ("GET", {"studyConfig": _study_config()}),
+            (":addMeasurement", {}),
+            (":addMeasurement", {}),
+        ])
+        svc = VizierStudyService("p", "r", "s", session=session,
+                                 sleeper=lambda s: None)
+        svc.report_intermediate("7", 1, 0.9)
+        svc.report_intermediate("7", 2, 0.8)
+        gets = [c for c in session.calls if c[0] == "GET"]
+        assert len(gets) == 1
+        _, _, body, _ = session.calls[-1]
+        assert body["measurement"]["metrics"] == [
+            {"metric": "loss", "value": 0.8}
+        ]
 
 
 class TestCloudTunerEndToEnd:
