@@ -136,16 +136,21 @@ _registry_lock = threading.Lock()
 
 
 def _build_native() -> Optional[ctypes.CDLL]:
-    if not os.path.exists(_LIB_PATH):
-        try:
-            subprocess.run(
-                ["make", "-C", _CPP_DIR, "libcloud_tpu_monitoring.so"],
-                check=True, capture_output=True, timeout=120,
-            )
-        except Exception as e:
+    # Always invoke make (no-op when up to date): a stale .so from before a
+    # source fix would otherwise keep loading forever, since the .so is
+    # gitignored and survives pulls.
+    try:
+        subprocess.run(
+            ["make", "-C", _CPP_DIR, "libcloud_tpu_monitoring.so"],
+            check=True, capture_output=True, timeout=120,
+        )
+    except Exception as e:
+        if not os.path.exists(_LIB_PATH):
             logger.info("native metrics build unavailable (%s); using "
                         "pure-Python registry", e)
             return None
+        logger.info("native metrics rebuild failed (%s); loading existing "
+                    "library", e)
     try:
         return ctypes.CDLL(_LIB_PATH)
     except OSError as e:

@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the hot ops, with reference fallbacks.
+
+Kernels live here, not in models/: a model expresses *what* to compute
+with logical-axis sharding; ops/ owns *how* the inner loop maps onto
+MXU/VMEM (pallas_guide.md).  Every op has a pure-jnp reference
+implementation used off-TPU (and as the ground truth in tests); dispatch
+is automatic.
+"""
+
+from cloud_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -49,6 +49,7 @@ class VizierStudyService:
         #: the service cannot attribute values to the study objective.
         #: Learned from study_config at create time, else fetched lazily.
         self._objective: Optional[str] = None
+        self._objective_fetched = False
 
     @property
     def _parent(self) -> str:
@@ -111,13 +112,17 @@ class VizierStudyService:
         return trial_id, vizier_utils.convert_vizier_trial_to_values(trial)
 
     def report_intermediate(self, trial_id: str, step: int, value: float) -> None:
+        # Resolve the metric name BEFORE the measurement call: a failure of
+        # the study-config GET must surface as a study-access error, not be
+        # mapped to SuggestionInactiveError(trial_id) below.
+        entry = self._metric_entry(value)
         try:
             self._session.post(
                 f"{_BASE}/{self._study_path}/trials/{trial_id}:addMeasurement",
                 body={
                     "measurement": {
                         "stepCount": str(step),
-                        "metrics": [self._metric_entry(value)],
+                        "metrics": [entry],
                     }
                 },
             )
@@ -170,11 +175,14 @@ class VizierStudyService:
         Workers that loaded (rather than created) the study learn the name
         by fetching the study config once.
         """
-        if self._objective is None:
+        if self._objective is None and not self._objective_fetched:
             study = self._session.get(f"{_BASE}/{self._study_path}")
             metrics = study.get("studyConfig", {}).get("metrics") or []
             if metrics:
                 self._objective = metrics[0].get("metric")
+            # Remember even a no-metrics answer: without this flag every
+            # measurement re-fetches the study on the reporting hot path.
+            self._objective_fetched = True
         if self._objective is None:
             return {"value": value}
         return {"metric": self._objective, "value": value}

@@ -174,7 +174,9 @@ def coerce_values(hps: hp_lib.HyperParameters, values: Dict[str, Any]) -> Dict[s
             out[spec.name] = float(v)
         elif isinstance(spec, hp_lib.Choice):
             for candidate in spec.values:
-                if str(candidate) == str(v):
+                # Numeric candidates come back as DISCRETE doubles (64 ->
+                # 64.0): == catches those; str() catches categorical strings.
+                if candidate == v or str(candidate) == str(v):
                     out[spec.name] = candidate
                     break
     return out

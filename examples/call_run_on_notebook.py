@@ -1,0 +1,26 @@
+"""Launch a .ipynb notebook as the training workload.
+
+Reference analogue: core/tests/examples/call_run_on_notebook_*.py — run()
+converts the notebook to a script (shell/magic lines stripped) before
+containerizing (notebook.py, reference preprocess.py:169-187).
+"""
+
+import os
+
+import cloud_tpu
+from cloud_tpu.core.containerize import DockerConfig
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "..", "tests", "testdata")
+
+
+def main(dry_run: bool = False):
+    return cloud_tpu.run(
+        entry_point=os.path.join(TESTDATA, "mnist_example_using_fit.ipynb"),
+        chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+        docker_config=DockerConfig(image="gcr.io/my-project/mnist-nb:demo"),
+        dry_run=dry_run,
+    )
+
+
+if __name__ == "__main__":
+    main()

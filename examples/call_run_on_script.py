@@ -1,0 +1,28 @@
+"""Launch a training script on a TPU slice — the simplest invocation.
+
+Reference analogue: core/tests/examples/call_run_on_script_* (run() pointed
+at a file, machine configs from the named catalog).
+"""
+
+import os
+
+import cloud_tpu
+from cloud_tpu.core.containerize import DockerConfig
+
+TESTDATA = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "testdata"
+)
+
+
+def main(dry_run: bool = False):
+    return cloud_tpu.run(
+        entry_point=os.path.join(TESTDATA, "mnist_example_using_fit.py"),
+        chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+        # Explicit image URI; omit to default to gcr.io/<project>/... via ADC.
+        docker_config=DockerConfig(image="gcr.io/my-project/mnist:demo"),
+        dry_run=dry_run,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Build the image with Cloud Build instead of a local docker daemon.
+
+Reference analogue: core/tests/examples/call_run_*_with_cloud_build.py —
+passing a GCS bucket switches the builder (containerize.py:386-507): the
+build context is tarred to the bucket and built server-side, so no local
+docker install is needed (the common case on Cloud TPU VMs).
+"""
+
+import os
+
+import cloud_tpu
+from cloud_tpu.core.containerize import DockerConfig
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "..", "tests", "testdata")
+
+
+def main(dry_run: bool = False):
+    return cloud_tpu.run(
+        entry_point=os.path.join(TESTDATA, "mnist_example_using_fit.py"),
+        chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+        docker_config=DockerConfig(
+            image="gcr.io/my-project/mnist:cloudbuild",
+            image_build_bucket="my-build-bucket",
+        ),
+        dry_run=dry_run,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Use a custom parent image and ship extra requirements.
+
+Reference analogue: core/tests/examples/call_run_within_script_with_
+autokeras.py:30-33 (custom base image for extra deps).  parent_image
+replaces the default python base; requirements_txt is pip-installed into
+the image.
+"""
+
+import os
+
+import cloud_tpu
+from cloud_tpu.core.containerize import DockerConfig
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "..", "tests", "testdata")
+
+
+def main(dry_run: bool = False):
+    return cloud_tpu.run(
+        entry_point=os.path.join(TESTDATA, "mnist_example_using_fit.py"),
+        requirements_txt=os.path.join(TESTDATA, "requirements.txt"),
+        chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+        docker_config=DockerConfig(
+            image="gcr.io/my-project/mnist:custom-base",
+            parent_image="python:3.12-slim",
+        ),
+        job_labels={"team": "research", "phase": "dev"},
+        dry_run=dry_run,
+    )
+
+
+if __name__ == "__main__":
+    main()

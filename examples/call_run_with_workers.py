@@ -1,0 +1,30 @@
+"""Scale to a multi-slice job with explicit parallelism hints.
+
+Beyond reference capability (SURVEY.md §2.6: it topped out at DP +
+TPUStrategy): pin mesh axes — tensor parallel within a slice, fsdp for the
+rest — and add worker slices; the planner validates the factorization and
+the bootstrap builds the same Mesh on every host.
+"""
+
+import os
+
+import cloud_tpu
+from cloud_tpu.core.containerize import DockerConfig
+from cloud_tpu.parallel import ParallelismHints
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "..", "tests", "testdata")
+
+
+def main(dry_run: bool = False):
+    return cloud_tpu.run(
+        entry_point=os.path.join(TESTDATA, "mnist_example_using_fit.py"),
+        chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU_V5E_16"],
+        worker_count=1,  # one extra slice; dp spans slices over DCN
+        parallelism_hints=ParallelismHints(tp=4, prefer_fsdp=True),
+        docker_config=DockerConfig(image="gcr.io/my-project/big-run:demo"),
+        dry_run=dry_run,
+    )
+
+
+if __name__ == "__main__":
+    main()

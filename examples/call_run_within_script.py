@@ -1,0 +1,38 @@
+"""A self-launching training script: run() called from inside the script.
+
+Reference analogue: core/tests/examples/call_run_within_script.py — the
+script-mode contract (SURVEY.md §3.2): locally, run() ships THIS file and
+exits; inside the container, remote() is true, run() returns immediately,
+and the training below executes under the bootstrap-installed mesh.
+"""
+
+import jax
+import numpy as np
+import optax
+
+import cloud_tpu
+from cloud_tpu.core.containerize import DockerConfig
+
+cloud_tpu.run(
+    # entry_point=None => script mode: sys.argv[0] (this file) is shipped.
+    chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+    worker_count=0,
+    docker_config=DockerConfig(image="gcr.io/my-project/self-launch:demo"),
+)
+
+# ---- everything below runs only in the cloud container ----
+from cloud_tpu import parallel  # noqa: E402
+from cloud_tpu.models import mnist  # noqa: E402
+from cloud_tpu.training import data, trainer  # noqa: E402
+
+rng = np.random.default_rng(0)
+images = rng.normal(size=(512, 28, 28)).astype(np.float32)
+labels = np.clip(((images.mean(axis=(1, 2)) + 0.5) * 10).astype(np.int32), 0, 9)
+
+t = trainer.Trainer(
+    mnist.loss_fn, optax.adam(1e-3), mnist.init,
+    mesh=parallel.get_global_mesh(),
+    logical_axes=mnist.param_logical_axes(),
+)
+t.init_state(jax.random.PRNGKey(0))
+t.fit(data.ArrayDataset({"image": images, "label": labels}, 64), epochs=3)

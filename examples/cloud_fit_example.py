@@ -1,0 +1,48 @@
+"""Fit an in-memory model remotely with cloud_fit.
+
+Reference analogue: experimental/cloud_fit (client.py:45): serialize the
+trainer spec + data + callbacks to a remote dir, submit a job whose
+container deserializes and fits.  Here the model is the in-memory object —
+no entry-point script at all.
+"""
+
+import optax
+
+from cloud_tpu.cloud_fit import client
+from cloud_tpu.cloud_fit.serialization import TrainerSpec
+from cloud_tpu.core.containerize import DockerConfig
+from cloud_tpu.models import mnist
+from cloud_tpu.training import trainer
+
+import numpy as np
+
+
+def main(remote_dir="gs://my-bucket/cloud_fit_demo", dry_run: bool = False,
+         **overrides):
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(512, 28, 28)).astype(np.float32)
+    labels = np.clip(
+        ((images.mean(axis=(1, 2)) + 0.5) * 10).astype(np.int32), 0, 9
+    )
+
+    spec = TrainerSpec(
+        loss_fn=mnist.loss_fn,
+        optimizer=optax.adam(1e-3),
+        init_fn=mnist.init,
+        logical_axes=mnist.param_logical_axes(),
+    )
+    return client.cloud_fit(
+        spec,
+        remote_dir,
+        train_data={"image": images, "label": labels},
+        callbacks=[trainer.ProgressLogger(every_n_steps=10)],
+        epochs=2,
+        batch_size=64,
+        docker_config=DockerConfig(image="gcr.io/my-project/cloudfit:demo"),
+        dry_run=dry_run,
+        **overrides,
+    )
+
+
+if __name__ == "__main__":
+    main()

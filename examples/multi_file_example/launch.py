@@ -1,0 +1,20 @@
+"""Launcher for the multi-file project: point run() at train.py; the whole
+directory (data_util.py included) lands in the build context."""
+
+import os
+
+import cloud_tpu
+from cloud_tpu.core.containerize import DockerConfig
+
+
+def main(dry_run: bool = False):
+    return cloud_tpu.run(
+        entry_point=os.path.join(os.path.dirname(__file__), "train.py"),
+        chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+        docker_config=DockerConfig(image="gcr.io/my-project/multifile:demo"),
+        dry_run=dry_run,
+    )
+
+
+if __name__ == "__main__":
+    main()

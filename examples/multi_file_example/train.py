@@ -1,0 +1,26 @@
+"""Entry point of a multi-file project (reference
+core/tests/examples/multi_file_example): run() ships the entry point's
+whole directory, so sibling modules import normally in the container."""
+
+import jax
+import optax
+
+from data_util import make_dataset  # sibling module, shipped with the entry
+
+from cloud_tpu import parallel
+from cloud_tpu.models import mnist
+from cloud_tpu.training import trainer
+
+
+def main():
+    t = trainer.Trainer(
+        mnist.loss_fn, optax.adam(1e-3), mnist.init,
+        mesh=parallel.get_global_mesh(),
+        logical_axes=mnist.param_logical_axes(),
+    )
+    t.init_state(jax.random.PRNGKey(0))
+    return t.fit(make_dataset(), epochs=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Golden minimal workload: dense MNIST classifier via Trainer.fit.
+
+Reference analogue: core/tests/testdata/mnist_example_using_fit.py (Keras
+Dense 512-relu -> 10 on flattened 28x28, model.fit under the injected
+strategy).  TPU-native shape: the script trains under whatever mesh the
+bootstrap runtime installed (``parallel.get_global_mesh()``), so the same
+file runs single-chip locally and data-parallel on a pod — no generated
+strategy prologue.
+
+Hermetic: synthetic arrays stand in for keras.datasets.mnist (the
+reference's download).  Set MNIST_EXAMPLE_EPOCHS / MNIST_EXAMPLE_STEPS to
+shrink the run (the test harness does).
+"""
+
+import os
+
+import jax
+import numpy as np
+import optax
+
+from cloud_tpu import parallel
+from cloud_tpu.models import mnist
+from cloud_tpu.training import data, trainer
+
+
+def make_datasets(n_train=512, n_test=128, batch_size=64, seed=0):
+    rng = np.random.default_rng(seed)
+
+    def synth(n):
+        images = rng.normal(size=(n, 28, 28)).astype(np.float32)
+        # Labels carry signal (mean-brightness bucket) so accuracy can move.
+        labels = np.clip(
+            ((images.mean(axis=(1, 2)) + 0.5) * 10).astype(np.int32), 0, 9
+        )
+        return {"image": images, "label": labels}
+
+    train = data.ArrayDataset(synth(n_train), batch_size, shuffle=True)
+    test = data.ArrayDataset(synth(n_test), batch_size)
+    return train, test
+
+
+def main():
+    epochs = int(os.environ.get("MNIST_EXAMPLE_EPOCHS", "3"))
+    steps = os.environ.get("MNIST_EXAMPLE_STEPS")
+    mesh = parallel.get_global_mesh()
+
+    train_ds, test_ds = make_datasets()
+    t = trainer.Trainer(
+        mnist.loss_fn,
+        optax.adam(1e-3),
+        mnist.init,
+        mesh=mesh,
+        logical_axes=mnist.param_logical_axes(),
+    )
+    t.init_state(jax.random.PRNGKey(0))
+    history = t.fit(
+        train_ds,
+        epochs=epochs,
+        steps_per_epoch=int(steps) if steps else None,
+        validation_data=test_ds,
+        callbacks=[trainer.ProgressLogger(every_n_steps=10)],
+    )
+
+    losses = history.history["loss"]
+    assert losses[-1] < losses[0], f"loss did not improve: {losses}"
+
+    # Chief-only bookkeeping write (reference save_and_load.py pattern).
+    save_dir = os.environ.get("MNIST_EXAMPLE_SAVE_DIR")
+    if save_dir and jax.process_index() == 0:
+        os.makedirs(save_dir, exist_ok=True)
+        with open(os.path.join(save_dir, "history.json"), "w") as f:
+            import json
+
+            json.dump(history.history, f)
+    return history
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Golden workload: hyperparameter search with CloudTuner.
+
+Reference analogue: core/tests/testdata/keras_tuner_cifar_example.py (133
+lines: KerasTuner hypermodel over CIFAR-10, CloudTuner against the Vizier
+service).  This version searches learning rate and hidden width for the
+MNIST dense model through the same oracle/tuner machinery, backed by the
+file-based LocalStudyService so it is hermetic; swapping in the Vizier
+client (`cloud_tpu.tuner.vizier_client`) is a one-line change.
+"""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+import optax
+
+from cloud_tpu import tuner as tuner_lib
+from cloud_tpu.models import mnist
+from cloud_tpu.training import data, trainer
+
+
+def make_dataset(n=256, batch_size=64, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.normal(size=(n, 28, 28)).astype(np.float32)
+    labels = np.clip(
+        ((images.mean(axis=(1, 2)) + 0.5) * 10).astype(np.int32), 0, 9
+    )
+    return data.ArrayDataset({"image": images, "label": labels}, batch_size)
+
+
+def build_hyperparameters():
+    hp = tuner_lib.HyperParameters()
+    hp.Float("learning_rate", 1e-4, 1e-1, sampling="log")
+    hp.Choice("hidden_dim", [64, 128])
+    return hp
+
+
+def hypermodel(hp):
+    config = mnist.MnistConfig(hidden_dim=hp.get("hidden_dim"))
+    t = trainer.Trainer(
+        lambda params, batch: mnist.loss_fn(params, batch, config),
+        optax.adam(hp.get("learning_rate")),
+        lambda rng: mnist.init(rng, config),
+        logical_axes=mnist.param_logical_axes(config),
+    )
+    t.init_state(jax.random.PRNGKey(0))
+    return t
+
+
+def main():
+    max_trials = int(os.environ.get("TUNER_EXAMPLE_MAX_TRIALS", "4"))
+    study_dir = os.environ.get("TUNER_EXAMPLE_STUDY_DIR") or tempfile.mkdtemp(
+        prefix="tuner_example_"
+    )
+    service = tuner_lib.LocalStudyService("mnist_hp_study", study_dir)
+    t = tuner_lib.CloudTuner(
+        hypermodel,
+        service,
+        objective="loss",
+        hyperparameters=build_hyperparameters(),
+        max_trials=max_trials,
+        tuner_id=os.environ.get("TUNER_ID", "tuner0"),
+    )
+    t.search(train_data=make_dataset(), epochs=1)
+
+    best = t.get_best_hyperparameters(1)[0]
+    print(
+        f"best: learning_rate={best.get('learning_rate'):.5f} "
+        f"hidden_dim={best.get('hidden_dim')}"
+    )
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,19 @@ class TestVizierConverters:
         names = {s.name for s in back.space}
         assert names == {"lr", "units", "stepped", "act", "flag"}
 
+    def test_coerce_values_restores_native_types(self):
+        hp = HyperParameters()
+        hp.Choice("hidden", [64, 128])  # numeric Choice -> DISCRETE doubles
+        hp.Choice("act", ["relu", "gelu"])
+        hp.Int("units", 32, 512)
+        hp.Boolean("flag")
+        out = vizier_utils.coerce_values(
+            hp,
+            {"hidden": 64.0, "act": "gelu", "units": 48.0, "flag": "False"},
+        )
+        assert out == {"hidden": 64, "act": "gelu", "units": 48, "flag": False}
+        assert type(out["hidden"]) is int
+
     def test_trial_to_values(self):
         trial = {
             "name": "projects/p/locations/r/studies/s/trials/7",
