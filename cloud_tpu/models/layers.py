@@ -108,25 +108,14 @@ def causal_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
                      causal: bool = True):
     """Reference (non-ring, non-Pallas) attention: [B, T, H, D] layout.
 
-    Softmax statistics in float32; matmuls stay in the input dtype so the
-    MXU sees bfloat16 operands.
+    Single source of truth lives in ops/flash_attention (its jnp reference
+    path); this wrapper keeps the historical layers.py entry point.  The
+    finite -1e30 mask value means fully-masked rows softmax to uniform
+    garbage instead of NaN; the loss mask drops such rows.
     """
-    dim = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dim)
-    scores = scores.astype(jnp.float32)
-    t_q, t_k = q.shape[1], k.shape[1]
-    # Finite mask value (not -inf): a fully-masked row (e.g. an all-padding
-    # example) then softmaxes to uniform garbage instead of NaN; the loss
-    # mask is responsible for dropping such rows.
-    neg = jnp.float32(-1e30)
-    if causal:
-        causal_mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
-        scores = jnp.where(causal_mask, scores, neg)
-    if mask is not None:
-        # mask: [B, T_k] valid-token mask
-        scores = jnp.where(mask[:, None, None, :], scores, neg)
-    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    from cloud_tpu.ops.flash_attention import _reference
+
+    return _reference(q, k, v, causal=causal, mask=mask)
 
 
 def attention_block_axes():

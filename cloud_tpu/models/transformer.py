@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
+from cloud_tpu import ops
 from cloud_tpu.models import layers, moe as moe_lib
 from cloud_tpu.parallel import mesh as mesh_lib
 from cloud_tpu.parallel.ring_attention import ring_attention
@@ -171,7 +172,8 @@ def _attention(
             check_vma=False,
         )(q, k, v)
     else:
-        attended = layers.causal_attention(q, k, v)
+        # Pallas flash kernel on TPU; jnp reference elsewhere (ops/__init__).
+        attended = ops.flash_attention(q, k, v, causal=True)
 
     attended = attended.reshape(b, t, h * hd)
     return layers.dense_apply(att_params["out"], attended)

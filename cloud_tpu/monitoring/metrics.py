@@ -135,22 +135,34 @@ _registry = None
 _registry_lock = threading.Lock()
 
 
+def _lib_stale() -> bool:
+    """The .so is gitignored and survives pulls: compare mtimes in-process
+    so the steady state never pays a make subprocess (and concurrent
+    workers only race on make when a rebuild is genuinely needed)."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for name in os.listdir(_CPP_DIR):
+        if name.endswith((".cc", ".h", "Makefile")):
+            if os.path.getmtime(os.path.join(_CPP_DIR, name)) > lib_mtime:
+                return True
+    return False
+
+
 def _build_native() -> Optional[ctypes.CDLL]:
-    # Always invoke make (no-op when up to date): a stale .so from before a
-    # source fix would otherwise keep loading forever, since the .so is
-    # gitignored and survives pulls.
-    try:
-        subprocess.run(
-            ["make", "-C", _CPP_DIR, "libcloud_tpu_monitoring.so"],
-            check=True, capture_output=True, timeout=120,
-        )
-    except Exception as e:
-        if not os.path.exists(_LIB_PATH):
-            logger.info("native metrics build unavailable (%s); using "
-                        "pure-Python registry", e)
-            return None
-        logger.info("native metrics rebuild failed (%s); loading existing "
-                    "library", e)
+    if _lib_stale():
+        try:
+            subprocess.run(
+                ["make", "-C", _CPP_DIR, "libcloud_tpu_monitoring.so"],
+                check=True, capture_output=True, timeout=120,
+            )
+        except Exception as e:
+            if not os.path.exists(_LIB_PATH):
+                logger.info("native metrics build unavailable (%s); using "
+                            "pure-Python registry", e)
+                return None
+            logger.info("native metrics rebuild failed (%s); loading stale "
+                        "library", e)
     try:
         return ctypes.CDLL(_LIB_PATH)
     except OSError as e:

@@ -1,0 +1,438 @@
+"""Flash attention as a Pallas TPU kernel, with a jnp reference fallback.
+
+Forward: online-softmax over K/V blocks — the grid's innermost dimension
+walks key blocks while VMEM scratch carries the running (max, sum, output)
+accumulators, so attention scores never materialize in HBM (memory
+O(block_q x block_k) instead of O(T^2)).  Backward: custom VJP with the
+standard recompute scheme — one kernel accumulates dQ over key blocks, one
+accumulates dK/dV over query blocks, both reusing the forward's saved
+logsumexp so no O(T^2) residuals are stored.
+
+Layout contract matches ``layers.causal_attention``: [B, T, H, D] in, same
+out.  Kernels run over [B, H, T, D] internally (last two dims tile onto
+the (8,128) VMEM lanes; D and the block sizes should be multiples of 128
+for full MXU tiles — head_dim 64 works, at half-lane occupancy).
+
+Dispatch: real TPU + tile-divisible shapes -> kernels; anything else (CPU
+tests, ragged shapes, explicit masks) -> ``_reference`` (pure jnp, XLA).
+The causal mask is applied in *global* positions so the kernels compose
+with ring attention's per-block fold later.
+
+No reference counterpart (SURVEY.md §5: the reference owns no kernels);
+this is TPU-native capability the rebuild adds.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # finite: fully-masked rows softmax to zeros, not NaN
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (ground truth + non-TPU fallback)
+# ---------------------------------------------------------------------------
+
+
+def _reference(q, k, v, *, causal, mask):
+    dim = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    s = s / math.sqrt(dim)
+    t_q, t_k = q.shape[1], k.shape[1]
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        s = jnp.where(causal_mask, s, NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Blocks strictly above the causal diagonal contribute nothing: skip
+    # the matmuls entirely (the grid still visits them; compute does not).
+    run = (
+        (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+    )
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]  # [block_q, D]
+        k = k_ref[0, 0]  # [block_k, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [block_q, 1] (value replicated over lanes)
+        l_prev = l_scr[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)  # [block_q, block_k] f32
+        correction = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, D]
+        acc_scr[...] = acc_scr[...] * correction + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        # lse carried as [block_q, 1] (trailing singleton keeps the block
+        # tile legal: Mosaic requires the last dim equal to the array's).
+        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(safe_l)
+
+
+def _check_divisible(t, block_q, block_k):
+    if t % block_q or t % block_k:
+        # The grid would silently skip the tail rows otherwise.
+        raise ValueError(
+            f"flash attention kernel needs T divisible by the block sizes; "
+            f"got T={t}, block_q={block_q}, block_k={block_k}"
+        )
+
+
+def _fwd_pallas(q, k, v, *, causal, block_q, block_k, interpret):
+    """q,k,v: [B, H, T, D] -> (out [B, H, T, D], lse [B, H, T, 1])."""
+    b, h, t, d = q.shape
+    _check_divisible(t, block_q, block_k)
+    nq, nk = t // block_q, t // block_k
+    scale = 1.0 / math.sqrt(d)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[qspec, kspec, kspec],
+        out_specs=[
+            qspec,
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((block_q, 128), jnp.float32),
+            _vmem((block_q, 128), jnp.float32),
+            _vmem((block_q, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+def _compiler_params():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (recompute scheme)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = (
+        (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+    )
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # [block_q, 1]
+        delta = delta_ref[0, 0]  # [block_q, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [block_q, block_k]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        ds = p * (dp - delta)  # [block_q, block_k] f32
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, block_q, block_k):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # Query blocks entirely above the diagonal see none of this key block.
+    run = (
+        (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    )
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # [block_q, 1]
+        delta = delta_ref[0, 0]  # [block_q, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [block_q, block_k]
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_k, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        ds = p * (dp - delta)
+        dk_scr[...] += scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_k, D]
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, do, out, lse, *, causal, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    _check_divisible(t, block_q, block_k)
+    nq, nk = t // block_q, t // block_k
+    scale = 1.0 / math.sqrt(d)
+    # delta_i = rowsum(dO_i * O_i): elementwise, XLA fuses it; no kernel.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )  # [B, H, T, 1], matching lse's layout
+
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kspec_i = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    rowspec = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0)
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(b, h, nq, nk),
+        in_specs=[qspec, kspec_i, kspec_i, qspec, rowspec, rowspec],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        scratch_shapes=[_vmem((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)[0]
+
+    # dK/dV: grid walks key blocks in the parallel dims, query blocks in the
+    # arbitrary (accumulating) dim.
+    kspec_o = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    qspec_j = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    rowspec_j = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, j, 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(b, h, nk, nq),
+        in_specs=[qspec_j, kspec_o, kspec_o, qspec_j, rowspec_j, rowspec_j],
+        out_specs=[kspec_o, kspec_o],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            _vmem((block_k, d), jnp.float32),
+            _vmem((block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + public dispatch
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _fwd_pallas(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    dq, dk, dv = _bwd_pallas(
+        q, k, v, g, out, lse, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _kernel_eligible(q, k, block_q, block_k) -> bool:
+    """Called with blocks already clamped to T: alignment must be checked on
+    the clamped values (T=100 clamps to block_q=100, which divides T but
+    breaks the (8,128) sublane tile — reject it)."""
+    t_q, t_k = q.shape[1], k.shape[1]
+    return (
+        q.ndim == 4
+        and q.shape == k.shape
+        and t_q % block_q == 0
+        and t_k % block_k == 0
+        and block_q % 8 == 0
+        and block_k % 8 == 0
+        and q.shape[-1] <= 256  # head_dim beyond this overflows VMEM blocks
+    )
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    mask: Optional[jnp.ndarray] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Attention over [B, T, H, D] tensors, differentiable.
+
+    ``use_pallas=None`` auto-dispatches: kernels on TPU when shapes tile,
+    reference jnp otherwise.  ``mask`` (a [B, T_k] valid-token mask) always
+    routes to the reference path.  ``interpret=True`` runs the kernels in
+    the Pallas interpreter (CPU tests of kernel logic).
+    """
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    if use_pallas is None:
+        use_pallas = (
+            jax.default_backend() == "tpu"
+            and mask is None
+            and _kernel_eligible(q, k, block_q, block_k)
+        )
+    if interpret:
+        use_pallas = True
+    if not use_pallas or mask is not None:
+        return _reference(q, k, v, causal=causal, mask=mask)
+    # [B, T, H, D] -> [B, H, T, D] for (T, D)-tiled kernels.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)

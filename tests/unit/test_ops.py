@@ -1,0 +1,160 @@
+"""Pallas kernel logic tests, run in interpreter mode on CPU.
+
+The reference implementations (pure jnp) are the ground truth; the
+interpreter executes the same kernel code paths that Mosaic compiles on
+TPU (the real-TPU compile is exercised by bench.py and the driver's
+entry() check).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloud_tpu.ops import flash_attention
+from cloud_tpu.ops.flash_attention import _reference
+
+
+def make_qkv(b=2, t=256, h=2, d=64, dtype=jnp.float32, seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(k1, (b, t, h, d), dtype),
+        jax.random.normal(k2, (b, t, h, d), dtype),
+        jax.random.normal(k3, (b, t, h, d), dtype),
+    )
+
+
+class TestFlashAttentionForward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = make_qkv()
+        ref = _reference(q, k, v, causal=causal, mask=None)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
+
+    def test_uneven_blocks(self):
+        # T=256 with block 128: multiple blocks, diagonal straddles them.
+        q, k, v = make_qkv(t=256)
+        ref = _reference(q, k, v, causal=True, mask=None)
+        out = flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=64, interpret=True
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
+
+    def test_block_larger_than_seq_clamps(self):
+        q, k, v = make_qkv(t=64)
+        ref = _reference(q, k, v, causal=True, mask=None)
+        out = flash_attention(
+            q, k, v, causal=True, block_q=512, block_k=512, interpret=True
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
+
+    def test_bfloat16(self):
+        q, k, v = make_qkv(dtype=jnp.bfloat16)
+        ref = _reference(q, k, v, causal=True, mask=None).astype(jnp.float32)
+        out = flash_attention(q, k, v, causal=True, interpret=True).astype(
+            jnp.float32
+        )
+        np.testing.assert_allclose(out, ref, atol=3e-2, rtol=3e-2)
+
+    def test_mask_routes_to_reference(self):
+        q, k, v = make_qkv(t=64)
+        mask = jnp.ones((2, 64), bool).at[:, 48:].set(False)
+        out = flash_attention(q, k, v, causal=True, mask=mask)
+        ref = _reference(q, k, v, causal=True, mask=mask)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
+
+
+class TestFlashAttentionBackward:
+    def test_grads_match_reference(self):
+        q, k, v = make_qkv()
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, causal=True, interpret=True)
+            return jnp.sum(out**2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_reference(q, k, v, causal=True, mask=None) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g_flash, g_ref):
+            np.testing.assert_allclose(
+                a, b, atol=5e-4, rtol=1e-3,
+                err_msg=f"grad mismatch for {name}",
+            )
+
+    def test_grads_non_causal(self):
+        q, k, v = make_qkv(t=128)
+        g_flash = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=False, interpret=True) ** 2
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_ref = jax.grad(
+            lambda q, k, v: jnp.sum(
+                _reference(q, k, v, causal=False, mask=None) ** 2
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3)
+
+
+class TestDispatch:
+    def test_cpu_falls_back_to_reference(self):
+        # On the CPU test platform auto-dispatch must not pick the kernel.
+        q, k, v = make_qkv(t=128)
+        out = flash_attention(q, k, v, causal=True)
+        ref = _reference(q, k, v, causal=True, mask=None)
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_ragged_shapes_fall_back(self):
+        # Auto-dispatch (use_pallas=None) must reject T=100: it clamps
+        # block_q to 100, which breaks the 8-sublane tile alignment.
+        from cloud_tpu.ops.flash_attention import _kernel_eligible
+
+        q, k, v = make_qkv(t=100)
+        assert not _kernel_eligible(q, k, block_q=100, block_k=100)
+        out = flash_attention(q, k, v, causal=True)  # default dispatch
+        ref = _reference(q, k, v, causal=True, mask=None)
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_kernel_eligibility_rules(self):
+        from cloud_tpu.ops.flash_attention import _kernel_eligible
+
+        q, k, v = make_qkv(t=256)
+        assert _kernel_eligible(q, k, block_q=128, block_k=128)
+        assert not _kernel_eligible(q, k, block_q=100, block_k=128)  # align
+        assert not _kernel_eligible(q, k, block_q=96, block_k=128)  # divide
+        q2, k2, v2 = make_qkv(t=256, d=512)
+        assert not _kernel_eligible(q2, k2, 128, 128)  # head_dim too large
+
+    def test_undivisible_blocks_raise_in_kernel_path(self):
+        q, k, v = make_qkv(t=100)
+        with pytest.raises(ValueError, match="divisible"):
+            flash_attention(
+                q, k, v, causal=True, use_pallas=True, block_q=64, block_k=64
+            )
+
+    def test_transformer_still_trains(self):
+        # The transformer's sp==1 path now routes through ops.flash_attention.
+        import optax
+
+        from cloud_tpu.models import transformer
+        from cloud_tpu.training import train as train_lib
+
+        config = transformer.TINY
+        state = train_lib.create_sharded_state(
+            jax.random.PRNGKey(0),
+            lambda rng: transformer.init(rng, config),
+            optax.adamw(1e-3),
+            mesh=None,
+        )
+        step = train_lib.make_train_step(
+            lambda p, b: transformer.loss_fn(p, b, config), optax.adamw(1e-3)
+        )
+        batch = {"tokens": np.zeros((2, 32), np.int32)}
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
