@@ -49,6 +49,26 @@ def main(argv=None) -> None:
 
     distributed.initialize_from_env()
 
+    # Env-gated observability, mirroring the reference's registered
+    # exporter (stackdriver_exporter.cc:31-36,128): the job spec turns
+    # these on per-host via CLOUD_TPU_MONITORING_ENABLED /
+    # CLOUD_TPU_PROFILER_PORT.
+    from cloud_tpu import monitoring
+
+    try:
+        if monitoring.start_exporter():
+            # The native timer thread calls back into Python; it must be
+            # joined before interpreter finalization or the next tick
+            # aborts in PyGILState_Ensure.  atexit also covers user
+            # scripts that sys.exit().
+            import atexit
+
+            atexit.register(monitoring.stop_exporter)
+    except Exception:
+        # Misconfigured monitoring must not kill the training job.
+        logger.exception("metrics exporter failed to start")
+    monitoring.profiler.maybe_start_server_from_env()
+
     entry_point = args.entry_point
     if entry_point.endswith(".ipynb"):
         from cloud_tpu.core import notebook

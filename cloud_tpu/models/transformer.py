@@ -171,8 +171,22 @@ def _attention(
             # axis-varying inside the fori_loop; skip VMA carry checking.
             check_vma=False,
         )(q, k, v)
-    else:
+    elif mesh is not None:
         # Pallas flash kernel on TPU; jnp reference elsewhere (ops/__init__).
+        # pallas_call is a custom call GSPMD cannot partition — unwrapped
+        # it would replicate the full [B,T,H,D] operands on every device.
+        # shard_map over the batch/heads shards keeps it local, matching
+        # the q/k/v shard_constraints above (seq unsharded since sp==1).
+        batch_axes = rules.assignment("batch")
+        heads_axes = rules.assignment("heads")
+        spec = PartitionSpec(batch_axes, None, heads_axes, None)
+        attended = jax.shard_map(
+            partial(ops.flash_attention, causal=True),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )(q, k, v)
+    else:
         attended = ops.flash_attention(q, k, v, causal=True)
 
     attended = attended.reshape(b, t, h * hd)

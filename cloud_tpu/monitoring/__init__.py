@@ -24,6 +24,7 @@ from cloud_tpu.monitoring.exporter import (
     start_exporter,
     stop_exporter,
 )
+from cloud_tpu.monitoring import profiler
 
 import time as _time
 
@@ -62,6 +63,7 @@ __all__ = [
     "counter_inc",
     "distribution_record",
     "gauge_set",
+    "profiler",
     "reset",
     "snapshot",
     "start_exporter",

@@ -18,7 +18,14 @@ std::string GetEnv(const char* name) {
 
 }  // namespace
 
-ExporterConfig::ExporterConfig() {
+ExporterConfig::ExporterConfig() { ReadFromEnv(); }
+
+void ExporterConfig::Reload() {
+  std::lock_guard<std::mutex> lock(mu_);
+  ReadFromEnv();
+}
+
+void ExporterConfig::ReadFromEnv() {
   std::string enabled = GetEnv("CLOUD_TPU_MONITORING_ENABLED");
   for (auto& c : enabled) c = static_cast<char>(std::tolower(c));
   // Case-insensitive, matching the Python-side gate exactly.
@@ -32,6 +39,7 @@ ExporterConfig::ExporterConfig() {
   // Comma-separated allowlist (stackdriver_config.cc:26-32); empty =>
   // export every metric (this framework's registry only holds framework
   // metrics, unlike TF's global registry which needed a default allowlist).
+  allowlist_.clear();
   std::stringstream ss(GetEnv("CLOUD_TPU_MONITORING_ALLOWLIST"));
   std::string item;
   while (std::getline(ss, item, ',')) {
@@ -44,10 +52,18 @@ ExporterConfig& ExporterConfig::Global() {
   return *config;
 }
 
-bool ExporterConfig::Enabled() const { return enabled_; }
-int ExporterConfig::IntervalSeconds() const { return interval_seconds_; }
+bool ExporterConfig::Enabled() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return enabled_;
+}
+
+int ExporterConfig::IntervalSeconds() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return interval_seconds_;
+}
 
 bool ExporterConfig::Allowed(const std::string& name) const {
+  std::lock_guard<std::mutex> lock(mu_);
   if (allowlist_.empty()) return true;
   return allowlist_.count(name) > 0;
 }
@@ -133,6 +149,10 @@ int ctpu_exporter_start() {
 }
 
 void ctpu_exporter_stop() { cloud_tpu::Exporter::Global().Stop(); }
+
+void ctpu_exporter_config_reload() {
+  cloud_tpu::ExporterConfig::Global().Reload();
+}
 
 void ctpu_exporter_export_once() {
   cloud_tpu::Exporter::Global().ExportOnce();

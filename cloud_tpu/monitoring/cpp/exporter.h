@@ -37,9 +37,15 @@ class ExporterConfig {
   // True if the metric is exported (allowlist semantics of
   // stackdriver_config.cc:34-45).
   bool Allowed(const std::string& name) const;
+  // Re-read the env vars.  The singleton caches them at first use, which
+  // may predate the host process deciding to enable monitoring (e.g. a
+  // snapshot is taken before StartExporter); Start() reloads first.
+  void Reload();
 
  private:
   ExporterConfig();
+  void ReadFromEnv();
+  mutable std::mutex mu_;
   bool enabled_;
   int interval_seconds_;
   std::set<std::string> allowlist_;
@@ -74,6 +80,7 @@ void ctpu_exporter_set_sink(cloud_tpu::SinkFn sink);
 int ctpu_exporter_start();
 void ctpu_exporter_stop();
 void ctpu_exporter_export_once();
+void ctpu_exporter_config_reload();
 }
 
 #endif  // CLOUD_TPU_MONITORING_EXPORTER_H_

@@ -138,6 +138,30 @@ _START_TIME = _now_rfc3339()  # process start = CUMULATIVE interval start
 _sink_keepalive = None  # the ctypes callback must outlive the C thread
 _python_thread: Optional[threading.Thread] = None
 _python_stop = threading.Event()
+_final_flush = None  # set by start_exporter; drains the last interval
+_started = False  # idempotency guard covering both backends
+
+
+def _env_allowlist() -> Set[str]:
+    """Same contract as the native exporter (CLOUD_TPU_MONITORING_ALLOWLIST,
+    ref stackdriver_config.cc:26-32); empty => export everything."""
+    return {
+        name
+        for name in os.environ.get(
+            "CLOUD_TPU_MONITORING_ALLOWLIST", ""
+        ).split(",")
+        if name
+    }
+
+
+def _filtered_snapshot(allowlist: Set[str]) -> dict:
+    snap = metrics_lib.snapshot()
+    if not allowlist:
+        return snap
+    return {
+        group: {k: v for k, v in values.items() if k in allowlist}
+        for group, values in snap.items()
+    }
 
 
 def start_exporter(project: Optional[str] = None, session=None) -> bool:
@@ -147,11 +171,16 @@ def start_exporter(project: Optional[str] = None, session=None) -> bool:
     Returns True if the exporter started.  Uses the native timer thread when
     the C++ library is live, else a Python thread.
     """
-    global _sink_keepalive, _python_thread
+    global _sink_keepalive, _python_thread, _final_flush, _started
     if os.environ.get("CLOUD_TPU_MONITORING_ENABLED", "").lower() not in (
         "1", "true",
     ):
         return False
+    if _started:
+        # Idempotent, matching Exporter::Start — and crucially *before*
+        # constructing a second exporter, which would rebind the sink and
+        # final flush onto a fresh descriptor-dedup set mid-run.
+        return True
     exporter = CloudMonitoringExporter(project=project, session=session)
 
     def sink_json(payload: str) -> None:
@@ -159,6 +188,11 @@ def start_exporter(project: Optional[str] = None, session=None) -> bool:
             exporter.export(json.loads(payload))
         except Exception:
             logger.exception("metrics export failed")
+
+    def final_flush() -> None:
+        sink_json(json.dumps(_filtered_snapshot(_env_allowlist())))
+
+    _final_flush = final_flush
 
     if metrics_lib.backend() == "native":
         lib = metrics_lib._get_registry()._lib  # type: ignore[union-attr]
@@ -170,44 +204,46 @@ def start_exporter(project: Optional[str] = None, session=None) -> bool:
         _sink_keepalive = SINK(c_sink)
         lib.ctpu_exporter_set_sink.argtypes = [SINK]
         lib.ctpu_exporter_set_sink(_sink_keepalive)
-        return bool(lib.ctpu_exporter_start())
+        # The C++ config singleton caches env at first touch, which may
+        # predate this call (any snapshot constructs it); re-read so the
+        # enable gate above and the native gate agree.
+        lib.ctpu_exporter_config_reload()
+        _started = bool(lib.ctpu_exporter_start())
+        return _started
 
-    if _python_thread is not None and _python_thread.is_alive():
-        return True  # idempotent, matching Exporter::Start
     interval = int(os.environ.get("CLOUD_TPU_MONITORING_INTERVAL", "10"))
-    allowlist = {
-        name
-        for name in os.environ.get(
-            "CLOUD_TPU_MONITORING_ALLOWLIST", ""
-        ).split(",")
-        if name
-    }
+    allowlist = _env_allowlist()
     _python_stop.clear()
-
-    def filtered_snapshot() -> dict:
-        snap = metrics_lib.snapshot()
-        if not allowlist:
-            return snap
-        return {
-            group: {k: v for k, v in values.items() if k in allowlist}
-            for group, values in snap.items()
-        }
 
     def loop():
         while not _python_stop.wait(interval):
-            sink_json(json.dumps(filtered_snapshot()))
+            sink_json(json.dumps(_filtered_snapshot(allowlist)))
 
     _python_thread = threading.Thread(target=loop, daemon=True)
     _python_thread.start()
+    _started = True
     return True
 
 
 def stop_exporter() -> None:
-    global _python_thread
+    """Stop the periodic thread and drain the final partial interval."""
+    global _python_thread, _final_flush, _started
     if metrics_lib.backend() == "native":
         lib = metrics_lib._get_registry()._lib  # type: ignore[union-attr]
-        lib.ctpu_exporter_stop()
+        lib.ctpu_exporter_stop()  # joins the C thread (exporter.cc:74-81)
     _python_stop.set()
+    joined = True
     if _python_thread is not None:
         _python_thread.join(timeout=5)
+        joined = not _python_thread.is_alive()
         _python_thread = None
+    if _final_flush is not None:
+        if joined:
+            # Safe: no loop thread shares the session/exporter anymore.
+            _final_flush()
+        else:
+            logger.warning(
+                "export loop still mid-request; skipping final flush"
+            )
+        _final_flush = None
+    _started = False

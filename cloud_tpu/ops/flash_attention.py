@@ -130,6 +130,13 @@ def _check_divisible(t, block_q, block_k):
             f"flash attention kernel needs T divisible by the block sizes; "
             f"got T={t}, block_q={block_q}, block_k={block_k}"
         )
+    if block_q % 8 or block_k % 8:
+        # Catches e.g. T=100 clamped to block=100: divisible, but Mosaic
+        # would fail the (8,128) sublane tile with a cryptic error.
+        raise ValueError(
+            f"flash attention blocks must be multiples of 8 (sublane tile); "
+            f"got block_q={block_q}, block_k={block_k}"
+        )
 
 
 def _fwd_pallas(q, k, v, *, causal, block_q, block_k, interpret):
@@ -383,18 +390,26 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _fit_block(t: int, block: int) -> Optional[int]:
+    """Largest multiple-of-8 block <= ``block`` that divides ``t``.
+
+    T=768 with the default block_k=512 fits at 384 (not a clamp — 512
+    doesn't divide 768); T=100 has no 8-aligned divisor and returns None
+    (the (8,128) sublane tile would break)."""
+    for candidate in range(min(block, t) - min(block, t) % 8, 7, -8):
+        if t % candidate == 0:
+            return candidate
+    return None
+
+
 def _kernel_eligible(q, k, block_q, block_k) -> bool:
-    """Called with blocks already clamped to T: alignment must be checked on
-    the clamped values (T=100 clamps to block_q=100, which divides T but
-    breaks the (8,128) sublane tile — reject it)."""
-    t_q, t_k = q.shape[1], k.shape[1]
+    """Called with blocks already fitted to T: both must have resolved to
+    8-aligned divisors of their sequence length."""
     return (
         q.ndim == 4
         and q.shape == k.shape
-        and t_q % block_q == 0
-        and t_k % block_k == 0
-        and block_q % 8 == 0
-        and block_k % 8 == 0
+        and block_q is not None
+        and block_k is not None
         and q.shape[-1] <= 256  # head_dim beyond this overflows VMEM blocks
     )
 
@@ -418,18 +433,23 @@ def flash_attention(
     routes to the reference path.  ``interpret=True`` runs the kernels in
     the Pallas interpreter (CPU tests of kernel logic).
     """
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
+    fitted_q = _fit_block(q.shape[1], block_q)
+    fitted_k = _fit_block(k.shape[1], block_k)
     if use_pallas is None:
         use_pallas = (
             jax.default_backend() == "tpu"
             and mask is None
-            and _kernel_eligible(q, k, block_q, block_k)
+            and _kernel_eligible(q, k, fitted_q, fitted_k)
         )
     if interpret:
         use_pallas = True
     if not use_pallas or mask is not None:
         return _reference(q, k, v, causal=causal, mask=mask)
+    # Requested blocks are upper bounds: run with the largest aligned
+    # divisor of T at or below them.  No aligned divisor (forced kernel
+    # path only) falls through to the clamp and _check_divisible's error.
+    block_q = fitted_q if fitted_q is not None else min(block_q, q.shape[1])
+    block_k = fitted_k if fitted_k is not None else min(block_k, k.shape[1])
     # [B, T, H, D] -> [B, H, T, D] for (T, D)-tiled kernels.
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)

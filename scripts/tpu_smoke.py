@@ -1,0 +1,90 @@
+"""Real-TPU smoke: Mosaic-compile the Pallas kernels and a train step.
+
+Run on a machine with a TPU backend (the unit suite pins itself to a
+virtual CPU mesh and never exercises the Mosaic compiler):
+
+    python scripts/tpu_smoke.py
+
+Exits non-zero on any compile failure or numeric divergence from the jnp
+reference path.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print(f"SKIP: default backend is {jax.default_backend()}, not tpu")
+        return 0
+
+    from cloud_tpu.ops import flash_attention
+    from cloud_tpu.ops.flash_attention import _reference
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (2, 512, 4, 64)  # [B, T, H, D]
+    q = jax.random.normal(k1, shape, jnp.bfloat16)
+    k = jax.random.normal(k2, shape, jnp.bfloat16)
+    v = jax.random.normal(k3, shape, jnp.bfloat16)
+
+    # Forward: compiled kernel vs reference.
+    out = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, use_pallas=True)
+    )(q, k, v)
+    ref = _reference(q, k, v, causal=True, mask=None)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=3e-2, rtol=3e-2,
+    )
+    print("flash_attention fwd: compiled, matches reference")
+
+    # Backward: custom VJP kernels.
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True, use_pallas=True).sum()
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    ref_grads = jax.grad(
+        lambda q, k, v: _reference(q, k, v, causal=True, mask=None).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g, rg, name in zip(grads, ref_grads, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(rg, np.float32),
+            atol=6e-2, rtol=6e-2,
+        )
+    print("flash_attention bwd: compiled, grads match reference")
+
+    # Full train step on the flagship model (auto-dispatch picks the kernel
+    # on TPU).
+    import optax
+
+    from cloud_tpu.models import transformer
+    from cloud_tpu.training import train as train_lib
+
+    config = transformer.TINY
+    state = train_lib.create_sharded_state(
+        jax.random.PRNGKey(0),
+        lambda rng: transformer.init(rng, config),
+        optax.adamw(1e-3),
+        mesh=None,
+    )
+    step = train_lib.make_train_step(
+        lambda p, b: transformer.loss_fn(p, b, config), optax.adamw(1e-3)
+    )
+    batch = {"tokens": np.zeros((2, 32), np.int32)}
+    state, metrics = step(state, batch)
+    loss_val = float(metrics["loss"])
+    assert np.isfinite(loss_val), loss_val
+    print(f"transformer train step: compiled, loss={loss_val:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -168,6 +168,33 @@ class TestExporterLifecycle:
         lib.ctpu_exporter_set_sink(SINK(0))
         assert received and received[0]["counters"]["native_path"] == 9
 
+    def test_start_idempotent_and_final_flush(self, monkeypatch):
+        """Double start must not rebind onto a second exporter; stop must
+        drain the last partial interval exactly once."""
+        monkeypatch.setenv("CLOUD_TPU_MONITORING_ENABLED", "1")
+        session = FakeSession()
+        try:
+            assert exporter_lib.start_exporter(project="p", session=session)
+            flush_before = exporter_lib._final_flush
+            # Second start: idempotent True, no new exporter/flush binding.
+            assert exporter_lib.start_exporter(
+                project="p", session=FakeSession()
+            )
+            assert exporter_lib._final_flush is flush_before
+            monitoring.counter_inc("lifecycle/steps", 3)
+        finally:
+            exporter_lib.stop_exporter()
+        assert exporter_lib._final_flush is None
+        assert not exporter_lib._started
+        flushed = [
+            body for _, body in session.calls
+            if any(
+                "lifecycle/steps" in ts["metric"]["type"]
+                for ts in body.get("timeSeries", [])
+            )
+        ]
+        assert flushed, "final flush did not export the last interval"
+
 
 class TestTrainerIntegration:
     def test_metrics_callback_records(self):

@@ -2,9 +2,13 @@
 
 The reference implementations (pure jnp) are the ground truth; the
 interpreter executes the same kernel code paths that Mosaic compiles on
-TPU (the real-TPU compile is exercised by bench.py and the driver's
-entry() check).
+TPU.  The real Mosaic compile has no coverage here — it is exercised by
+``TestTPUCompile`` (subprocess on the default backend, opt-in via
+CLOUD_TPU_RUN_TPU_TESTS=1 since a cold compile costs ~30 s) and by
+``scripts/tpu_smoke.py``.
 """
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -111,25 +115,42 @@ class TestDispatch:
         np.testing.assert_allclose(out, ref, atol=1e-6)
 
     def test_ragged_shapes_fall_back(self):
-        # Auto-dispatch (use_pallas=None) must reject T=100: it clamps
-        # block_q to 100, which breaks the 8-sublane tile alignment.
-        from cloud_tpu.ops.flash_attention import _kernel_eligible
+        # Auto-dispatch (use_pallas=None) must reject T=100: no multiple-
+        # of-8 block divides it, so the 8-sublane tile can't be kept.
+        from cloud_tpu.ops.flash_attention import _fit_block, _kernel_eligible
 
         q, k, v = make_qkv(t=100)
-        assert not _kernel_eligible(q, k, block_q=100, block_k=100)
+        assert _fit_block(100, 256) is None
+        assert not _kernel_eligible(q, k, block_q=None, block_k=None)
         out = flash_attention(q, k, v, causal=True)  # default dispatch
         ref = _reference(q, k, v, causal=True, mask=None)
         np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_fit_block(self):
+        from cloud_tpu.ops.flash_attention import _fit_block
+
+        assert _fit_block(256, 128) == 128  # exact divisor kept
+        assert _fit_block(64, 512) == 64  # clamps to T
+        assert _fit_block(768, 512) == 384  # shrinks to a divisor, not T
+        assert _fit_block(384, 512) == 384
+        assert _fit_block(100, 256) is None  # no 8-aligned divisor
 
     def test_kernel_eligibility_rules(self):
         from cloud_tpu.ops.flash_attention import _kernel_eligible
 
         q, k, v = make_qkv(t=256)
         assert _kernel_eligible(q, k, block_q=128, block_k=128)
-        assert not _kernel_eligible(q, k, block_q=100, block_k=128)  # align
-        assert not _kernel_eligible(q, k, block_q=96, block_k=128)  # divide
+        assert not _kernel_eligible(q, k, block_q=None, block_k=128)
         q2, k2, v2 = make_qkv(t=256, d=512)
         assert not _kernel_eligible(q2, k2, 128, 128)  # head_dim too large
+
+    def test_undivisible_seq_interpret_uses_fit(self):
+        # T=384: default blocks (256/512) don't divide it, but the fit
+        # (128/384) does — the kernel path must run, not error.
+        q, k, v = make_qkv(t=384)
+        ref = _reference(q, k, v, causal=True, mask=None)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
 
     def test_undivisible_blocks_raise_in_kernel_path(self):
         q, k, v = make_qkv(t=100)
@@ -137,6 +158,14 @@ class TestDispatch:
             flash_attention(
                 q, k, v, causal=True, use_pallas=True, block_q=64, block_k=64
             )
+
+    def test_unalignable_seq_raises_in_kernel_path(self):
+        # T=100 with default blocks clamps to block=100, which divides T
+        # but breaks the 8-sublane tile: must be a clean ValueError, not a
+        # Mosaic lowering failure.
+        q, k, v = make_qkv(t=100)
+        with pytest.raises(ValueError, match="multiples of 8"):
+            flash_attention(q, k, v, causal=True, use_pallas=True)
 
     def test_transformer_still_trains(self):
         # The transformer's sp==1 path now routes through ops.flash_attention.
@@ -158,3 +187,28 @@ class TestDispatch:
         batch = {"tokens": np.zeros((2, 32), np.int32)}
         state, metrics = step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.skipif(
+    not os.environ.get("CLOUD_TPU_RUN_TPU_TESTS"),
+    reason="real-TPU Mosaic compile; opt in with CLOUD_TPU_RUN_TPU_TESTS=1",
+)
+class TestTPUCompile:
+    def test_smoke_subprocess(self):
+        # The suite pins this process to a virtual CPU mesh (conftest), so
+        # the Mosaic compile runs in a subprocess on the default backend.
+        import subprocess
+        import sys
+
+        env = {k: v for k, v in os.environ.items()}
+        env.pop("JAX_PLATFORMS", None)  # let sitecustomize pick the TPU
+        env.pop("XLA_FLAGS", None)
+        script = os.path.join(
+            os.path.dirname(__file__), "..", "..", "scripts", "tpu_smoke.py"
+        )
+        result = subprocess.run(
+            [sys.executable, script], env=env, capture_output=True, text=True,
+            timeout=600,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "SKIP" not in result.stdout, result.stdout
