@@ -20,8 +20,8 @@ BATCH_SIZE = 256
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
 
-#: Filled from the first recorded run (BASELINE.md); ratio reported as
-#: vs_baseline thereafter.
+#: Filled from the first honestly-timed recorded run (BASELINE.md — see its
+#: "Timing methodology" note); ratio reported as vs_baseline thereafter.
 RECORDED_BASELINE_STEPS_PER_SEC = None
 
 
@@ -56,12 +56,18 @@ def main():
 
     for _ in range(WARMUP_STEPS):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
+    # Timing contract: chain MEASURE_STEPS steps (each consumes the prior
+    # state, so the device must execute all of them sequentially), then
+    # force a host round-trip on the final loss.  device_get rather than
+    # block_until_ready: on remote-tunnel backends block_until_ready can
+    # return before remote execution completes, inflating throughput ~50x;
+    # the data dependency + host read cannot lie.
     start = time.perf_counter()
     for _ in range(MEASURE_STEPS):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     elapsed = time.perf_counter() - start
 
     steps_per_sec = MEASURE_STEPS / elapsed

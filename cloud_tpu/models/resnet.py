@@ -35,6 +35,10 @@ class ResNetConfig:
 RESNET50 = ResNetConfig()
 #: CIFAR-10-scale variant for tests and the CIFAR baseline config.
 RESNET50_CIFAR = ResNetConfig(num_classes=10)
+#: Tiny variant for notebooks/examples: one block per stage, narrow.
+RESNET8_CIFAR = ResNetConfig(
+    stage_sizes=(1, 1, 1, 1), width=16, num_classes=10, num_groups=8
+)
 
 
 def _conv_init(rng, kh, kw, cin, cout):

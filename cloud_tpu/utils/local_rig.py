@@ -1,0 +1,53 @@
+"""The local test rig: run the container bootstrap on a virtual CPU mesh.
+
+One place for the non-obvious incantation (disable any TPU plugin, force
+the CPU platform, fake N devices) shared by the integration tests, the
+baseline measurements, and laptop dry runs — SURVEY.md §4's takeaway (c):
+the reference faked clusters via TF_CONFIG; this framework fakes a slice
+via XLA's host-platform device count.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, Optional
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def virtual_mesh_env(
+    n_devices: int = 8, extra: Optional[Dict[str, str]] = None
+) -> Dict[str, str]:
+    """Subprocess env that boots JAX as ``n_devices`` virtual CPU devices."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # force-disable any TPU plugin
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={n_devices}",
+        "PYTHONPATH": REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.update(extra or {})
+    return env
+
+
+def run_bootstrap(
+    entry_point: str,
+    *,
+    mesh_plan_json: Optional[str] = None,
+    n_devices: int = 8,
+    extra_env: Optional[Dict[str, str]] = None,
+    timeout: int = 600,
+) -> subprocess.CompletedProcess:
+    """Execute the container ENTRYPOINT locally on the virtual mesh."""
+    cmd = [sys.executable, "-m", "cloud_tpu.core.bootstrap",
+           "--entry-point", entry_point]
+    if mesh_plan_json is not None:
+        cmd += ["--mesh-plan", mesh_plan_json]
+    return subprocess.run(
+        cmd, env=virtual_mesh_env(n_devices, extra_env),
+        capture_output=True, text=True, timeout=timeout,
+    )

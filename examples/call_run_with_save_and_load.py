@@ -1,0 +1,32 @@
+"""Launch the checkpoint/restore/resume workload.
+
+Reference analogue: core/tests/examples/call_run_on_script_with_keras_save_and_load.py
+— run() pointed at testdata save_and_load.py (user-owned strategy +
+chief-aware save paths).  The TPU-native version checkpoints with Orbax,
+where every process writes its own shards, so the script works unchanged
+from 1 chip to a pod.
+"""
+
+import os
+
+import cloud_tpu
+from cloud_tpu.core.containerize import DockerConfig
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "..", "tests", "testdata")
+
+
+def main(dry_run: bool = False):
+    return cloud_tpu.run(
+        entry_point=os.path.join(TESTDATA, "save_and_load.py"),
+        chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+        # The workload owns its mesh (builds one itself): opt out of the
+        # planner, mirroring reference distribution_strategy=None
+        # (validate.py:117-124).
+        distribution_strategy=None,
+        docker_config=DockerConfig(image="gcr.io/my-project/ckpt:demo"),
+        dry_run=dry_run,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Launch a hyperparameter-search workload onto TPU workers.
+
+Reference analogue: core/tests/examples/call_run_on_script_with_keras_tuner_search.py
+— run() pointed at a tuner workload (testdata keras_tuner_cifar_example.py).
+Here the shipped script drives CloudTuner over the MNIST dense model; each
+submitted job is one tuner worker, and N invocations with a shared study
+id give distributed search (SURVEY.md §2.6 "data-parallel HP search").
+"""
+
+import os
+
+import cloud_tpu
+from cloud_tpu.core.containerize import DockerConfig
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "..", "tests", "testdata")
+
+
+def main(dry_run: bool = False):
+    return cloud_tpu.run(
+        entry_point=os.path.join(TESTDATA, "tuner_mnist_example.py"),
+        chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+        docker_config=DockerConfig(image="gcr.io/my-project/tuner:demo"),
+        # Trials coordinate through the study service, not the mesh —
+        # parallelism comes from submitting this job several times.
+        job_labels={"workload": "hp-search"},
+        dry_run=dry_run,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+"""Measure the BASELINE.json workload configs and print one JSON per line.
+
+Configs (BASELINE.md "Workload configs to measure"):
+  1. MNIST dense fit — single device.
+  2. ResNet50 CIFAR-10 train step — the bench.py north-star (run bench.py).
+  3. BERT-base fine-tune train step.
+  4. CloudTuner HP search throughput (local study service).
+  5. Data-pipeline throughput (host -> device, the tf.data analogue).
+Plus the second north-star: run() submit-to-first-step latency, measured
+as dry-run artifact generation + bootstrap-to-first-completed-step on the
+local backend.
+
+Run on the target hardware:  python scripts/measure_baselines.py
+"""
+
+import functools
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _throughput(step, state, batch, *, warmup=3, iters=20):
+    """Chain iters steps then force a host read of the final loss.
+
+    The state dependency makes the device execute every step before the
+    final metric exists; reading it to host (float()) is the only wait
+    that remote-tunnel backends cannot satisfy early (block_until_ready
+    can return before remote execution completes there)."""
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    float(next(iter(metrics.values())))
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    float(next(iter(metrics.values())))
+    return iters / (time.perf_counter() - start)
+
+
+def emit(metric, value, unit):
+    print(json.dumps({"metric": metric, "value": round(value, 3),
+                      "unit": unit}))
+
+
+def measure_mnist():
+    import jax
+    import optax
+
+    from cloud_tpu.models import mnist
+    from cloud_tpu.training import train as train_lib
+
+    cfg = mnist.MnistConfig()
+    state = train_lib.create_sharded_state(
+        jax.random.PRNGKey(0), functools.partial(mnist.init, config=cfg),
+        optax.adam(1e-3), mesh=None,
+    )
+    step = train_lib.make_train_step(
+        functools.partial(mnist.loss_fn, config=cfg), optax.adam(1e-3)
+    )
+    batch = {
+        "image": np.random.randn(512, 28, 28).astype(np.float32),
+        "label": np.zeros((512,), np.int64),
+    }
+    emit("mnist_dense_b512_train_steps_per_sec", _throughput(step, state, batch),
+         "steps/sec")
+
+
+def measure_bert():
+    import jax
+    import optax
+
+    from cloud_tpu.models import bert
+    from cloud_tpu.training import train as train_lib
+
+    cfg = bert.BERT_BASE
+    state = train_lib.create_sharded_state(
+        jax.random.PRNGKey(0), functools.partial(bert.init, cfg=cfg),
+        optax.adamw(2e-5), mesh=None,
+    )
+    step = train_lib.make_train_step(
+        functools.partial(bert.loss_fn, cfg=cfg), optax.adamw(2e-5)
+    )
+    batch = {
+        "tokens": np.ones((32, 128), np.int32),
+        "label": np.zeros((32,), np.int64),
+    }
+    emit("bert_base_finetune_b32_s128_train_steps_per_sec",
+         _throughput(step, state, batch, iters=10), "steps/sec")
+
+
+def measure_tuner():
+    import jax
+    import optax
+
+    from cloud_tpu import tuner as tuner_lib
+    from cloud_tpu.models import mnist
+    from cloud_tpu.training import data, trainer
+
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(256, 28, 28)).astype(np.float32)
+    labels = np.clip(((images.mean(axis=(1, 2)) + 0.5) * 10).astype(np.int32),
+                     0, 9)
+    dataset = data.ArrayDataset({"image": images, "label": labels}, 64)
+
+    hp = tuner_lib.HyperParameters()
+    hp.Float("learning_rate", 1e-4, 1e-1, sampling="log")
+
+    def hypermodel(hp):
+        cfg = mnist.MnistConfig(hidden_dim=64)
+        t = trainer.Trainer(
+            functools.partial(mnist.loss_fn, config=cfg),
+            optax.adam(hp.get("learning_rate")),
+            functools.partial(mnist.init, config=cfg),
+        )
+        t.init_state(jax.random.PRNGKey(0))
+        return t
+
+    with tempfile.TemporaryDirectory() as tmp:
+        service = tuner_lib.LocalStudyService("bench", tmp, max_trials=6)
+        tuner = tuner_lib.CloudTuner(
+            hypermodel, service, objective="loss",
+            hyperparameters=hp, max_trials=6,
+        )
+        start = time.perf_counter()
+        tuner.search(train_data=dataset, epochs=1)
+        elapsed = time.perf_counter() - start
+    emit("cloudtuner_mnist_trials_per_min", 6 / (elapsed / 60), "trials/min")
+
+
+def measure_data_pipeline():
+    import jax
+
+    from cloud_tpu.training import data
+
+    arrays = {
+        "image": np.random.randn(4096, 32, 32, 3).astype(np.float32),
+        "label": np.zeros((4096,), np.int64),
+    }
+    ds = data.ArrayDataset(arrays, batch_size=256)
+
+    def put(batch):
+        dev = jax.device_put(batch)
+        # Read one element back: forces the transfer to have really
+        # happened (see _throughput docstring re block_until_ready).
+        float(dev["image"][0, 0, 0, 0])
+
+    # Warm one epoch, then measure host->device delivery.
+    for batch in ds():
+        put(batch)
+    start = time.perf_counter()
+    n = 0
+    for batch in ds():
+        put(batch)
+        n += batch["image"].shape[0]
+    elapsed = time.perf_counter() - start
+    emit("data_pipeline_images_per_sec_host_to_device", n / elapsed,
+         "images/sec")
+
+
+def measure_submit_latency():
+    """run() dry-run artifacts + bootstrap to first completed step."""
+    import cloud_tpu
+    from cloud_tpu.core.containerize import DockerConfig
+
+    testdata = os.path.join(REPO, "tests", "testdata")
+    start = time.perf_counter()
+    report = cloud_tpu.run(
+        entry_point=os.path.join(testdata, "mnist_example_using_fit.py"),
+        chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+        docker_config=DockerConfig(image="gcr.io/p/bench:t"),
+        dry_run=True,
+    )
+    submit_s = time.perf_counter() - start
+
+    # The plan targets a v5e-8; emulate its 8 chips on the shared virtual
+    # CPU rig so the measurement covers mesh build + distributed init +
+    # compile, not the local chip count.
+    from cloud_tpu.utils import local_rig
+
+    start = time.perf_counter()
+    result = local_rig.run_bootstrap(
+        os.path.join(testdata, "mnist_example_using_fit.py"),
+        mesh_plan_json=report.mesh_plan.to_json(),
+        extra_env={"MNIST_EXAMPLE_EPOCHS": "2", "MNIST_EXAMPLE_STEPS": "1"},
+    )
+    bootstrap_s = time.perf_counter() - start
+    assert result.returncode == 0, result.stderr
+    emit("run_submit_artifacts_seconds", submit_s, "s")
+    emit("bootstrap_to_first_step_seconds", bootstrap_s, "s")
+
+
+def main():
+    measure_mnist()
+    measure_bert()
+    measure_data_pipeline()
+    measure_tuner()
+    measure_submit_latency()
+
+
+if __name__ == "__main__":
+    main()

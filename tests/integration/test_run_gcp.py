@@ -1,0 +1,88 @@
+"""GCP-gated integration tests: real submissions, no asserts on results.
+
+Reference pattern (core/tests/integration/run_on_script_test.py,
+tuner/tests/integration/tuner_integration_test.py): parameterized by env
+vars, success criterion = the job/study was accepted by the service.
+Skipped wholesale unless CLOUD_TPU_TEST_PROJECT (and for image builds
+CLOUD_TPU_TEST_BUCKET) are set — these never run in hermetic CI.
+"""
+
+import os
+import uuid
+
+import pytest
+
+import cloud_tpu
+from cloud_tpu.core.containerize import DockerConfig
+
+PROJECT = os.environ.get("CLOUD_TPU_TEST_PROJECT")
+BUCKET = os.environ.get("CLOUD_TPU_TEST_BUCKET")
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+TESTDATA = os.path.join(REPO, "tests", "testdata")
+
+pytestmark = pytest.mark.skipif(
+    not PROJECT, reason="set CLOUD_TPU_TEST_PROJECT to run GCP integration"
+)
+
+
+def _image(tag: str) -> str:
+    return f"gcr.io/{PROJECT}/cloud-tpu-it-{tag}:{uuid.uuid4().hex[:8]}"
+
+
+class TestRunOnScript:
+    def test_single_slice(self):
+        report = cloud_tpu.run(
+            entry_point=os.path.join(TESTDATA, "mnist_example_using_fit.py"),
+            chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+            docker_config=DockerConfig(
+                image=_image("single"), image_build_bucket=BUCKET
+            ),
+            job_labels={"suite": "integration"},
+        )
+        assert report.submitted
+
+    def test_multi_slice(self):
+        report = cloud_tpu.run(
+            entry_point=os.path.join(TESTDATA, "mnist_example_using_fit.py"),
+            chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU_V5E_16"],
+            worker_count=1,
+            docker_config=DockerConfig(
+                image=_image("multi"), image_build_bucket=BUCKET
+            ),
+        )
+        assert report.submitted
+        assert len(report.node_requests) == 2
+
+    def test_user_owned_mesh(self):
+        report = cloud_tpu.run(
+            entry_point=os.path.join(TESTDATA, "save_and_load.py"),
+            chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+            distribution_strategy=None,
+            docker_config=DockerConfig(
+                image=_image("owned"), image_build_bucket=BUCKET
+            ),
+        )
+        assert report.submitted
+
+
+class TestVizierTuner:
+    def test_study_roundtrip(self):
+        from cloud_tpu.tuner import vizier_client
+
+        service = vizier_client.VizierStudyService(
+            project=PROJECT,
+            region=os.environ.get("CLOUD_TPU_TEST_REGION", "us-central1"),
+            study_id=f"it_{uuid.uuid4().hex[:8]}",
+        )
+        service.create_or_load_study({
+            "metrics": [{"metric": "loss", "goal": "MINIMIZE"}],
+            "parameters": [{
+                "parameter": "lr", "type": "DOUBLE",
+                "double_value_spec": {"min_value": 1e-4, "max_value": 0.1},
+            }],
+        })
+        try:
+            trials = service.list_trials()
+            assert isinstance(trials, list)
+        finally:
+            service.delete_study()

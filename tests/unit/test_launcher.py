@@ -350,6 +350,24 @@ class TestRun:
         assert session.calls  # node creation went through the fake session
         assert report.job_id.startswith("cloud-tpu-train-")
 
+    def test_script_mode_exits_after_submit(self, tmp_path, monkeypatch):
+        # The local half of the within-script contract (SURVEY.md §3.2):
+        # entry_point=None ships sys.argv[0] and exits so the training
+        # code below run() never executes locally (reference asserted
+        # sys.exit the same way, run_on_script_test.py:37).
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "proj")
+        script = tmp_path / "self_launch.py"
+        script.write_text("print('x')")
+        monkeypatch.setattr(sys, "argv", [str(script)])
+
+        class FakeBuilder:
+            def get_docker_image(self):
+                return "gcr.io/proj/built:1"
+
+        with pytest.raises(SystemExit) as excinfo:
+            run_lib.run(_builder=FakeBuilder(), _session=FakeSession())
+        assert excinfo.value.code == 0
+
 
 class TestNotebook:
     def test_conversion_strips_magics(self, tmp_path):
