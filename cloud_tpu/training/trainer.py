@@ -63,6 +63,64 @@ class ProgressLogger(Callback):
             logger.info("step %d: %s", step, rendered)
 
 
+class EarlyStopping(Callback):
+    """Stop training when a monitored metric stops improving.
+
+    Keras-parity semantics (the reference shipped user EarlyStopping
+    callbacks through cloud_fit's pickle path): ``monitor`` reads the
+    epoch logs (use ``val_``-prefixed keys for validation metrics),
+    ``patience`` counts non-improving epochs, ``restore_best_state``
+    reinstates the best TrainState on stop (host copy, so it survives
+    donated device buffers).
+    """
+
+    def __init__(self, monitor: str = "val_loss", *, min_delta: float = 0.0,
+                 patience: int = 0, mode: str = "auto",
+                 restore_best_state: bool = False):
+        if mode not in ("auto", "min", "max"):
+            raise ValueError(f"mode must be auto|min|max, got {mode!r}")
+        self.monitor = monitor
+        self.min_delta = abs(min_delta)
+        self.patience = patience
+        self.restore_best_state = restore_best_state
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self._sign = 1.0 if mode == "max" else -1.0
+        self._best = -float("inf")
+        self._wait = 0
+        self._best_state = None
+        self.stopped_epoch: Optional[int] = None
+
+    def on_train_begin(self, trainer):
+        self._best = -float("inf")
+        self._wait = 0
+        self._best_state = None
+        self.stopped_epoch = None
+
+    def on_epoch_end(self, epoch, logs, trainer):
+        if self.monitor not in logs:
+            logger.warning(
+                "EarlyStopping: %r not in epoch logs %s", self.monitor,
+                sorted(logs),
+            )
+            return
+        current = self._sign * float(logs[self.monitor])
+        if current > self._best + self.min_delta:
+            self._best = current
+            self._wait = 0
+            if self.restore_best_state:
+                self._best_state = jax.device_get(trainer.state)
+        else:
+            self._wait += 1
+            if self._wait > self.patience:
+                self.stopped_epoch = epoch
+                trainer.stop_training = True
+
+    def on_train_end(self, trainer):
+        if self.restore_best_state and self._best_state is not None:
+            trainer.state = jax.device_put(self._best_state)
+
+
 class LambdaCallback(Callback):
     """Ad-hoc hooks, cloudpickle-friendly (reference ships these through
     cloud_fit, remote_test.py:41-53)."""
