@@ -12,9 +12,9 @@ with the ``jax.distributed`` env contract filled in — replacing both CAIP's
 from __future__ import annotations
 
 import logging
-import subprocess
+import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from cloud_tpu.core import gcp, machine_config
 from cloud_tpu.parallel import planner
@@ -23,6 +23,18 @@ from cloud_tpu.utils import api_client
 logger = logging.getLogger(__name__)
 
 _TPU_API = "https://tpu.googleapis.com/v2"
+
+#: Node-create LRO poll budget (creation request acknowledged).
+_LRO_POLL_INTERVAL_SECONDS = 5
+_LRO_POLL_ATTEMPTS = 60
+#: Node READY-state poll budget — the analogue of the reference's TPU
+#: provisioning wait, 40 x 10 s (preprocess.py:238-261).
+_READY_POLL_INTERVAL_SECONDS = 10
+_READY_POLL_ATTEMPTS = 40
+
+
+class ProvisioningError(RuntimeError):
+    """A TPU node failed to provision; partial slices were rolled back."""
 
 
 def _job_id() -> str:
@@ -139,12 +151,22 @@ def deploy_job(
     session: Optional[api_client.GcpApiSession] = None,
     stream_logs: bool = False,
     request: Optional[dict] = None,
+    wait_for_ready: bool = True,
+    sleep: Callable[[float], None] = time.sleep,
 ) -> dict:
     """Create the TPU nodes for the job; returns job info incl. console URL.
 
     ``request`` may carry a prebuilt ``build_job_request`` result (run()
     builds one for its report; passing it here guarantees the submitted
     nodes are exactly the reported ones).
+
+    Lifecycle (the part the reference delegated to CAIP's managed
+    ``cloud_tpu`` worker — SURVEY.md §7 hard parts): each create's LRO is
+    polled to completion, then the node is awaited READY under the
+    reference's 40 x 10 s provisioning budget (preprocess.py:238-261).  If
+    any slice fails, every already-created slice is deleted before the
+    error propagates — a multi-slice job never leaks stray paid-for nodes.
+    ``wait_for_ready=False`` degrades to fire-and-forget submission.
     """
     if not chief_config.is_tpu():
         raise NotImplementedError(
@@ -165,11 +187,32 @@ def deploy_job(
             job_labels=job_labels, service_account=service_account,
         )
     parent = f"projects/{project}/locations/{zone}"
-    for node_id, body in request["nodes"].items():
-        session.post(
-            f"{_TPU_API}/{parent}/nodes", body=body, params={"nodeId": node_id}
-        )
-        logger.info("created TPU node %s (%s)", node_id, body["acceleratorType"])
+    created: List[str] = []
+    try:
+        operations = {}
+        for node_id, body in request["nodes"].items():
+            op = session.post(
+                f"{_TPU_API}/{parent}/nodes", body=body,
+                params={"nodeId": node_id},
+            )
+            created.append(node_id)
+            operations[node_id] = op
+            logger.info(
+                "creating TPU node %s (%s)", node_id, body["acceleratorType"]
+            )
+        if wait_for_ready:
+            for node_id, op in operations.items():
+                _await_operation(session, op, node_id, sleep=sleep)
+                _await_node_ready(
+                    session, parent, node_id, sleep=sleep
+                )
+    except Exception as exc:
+        logger.error("provisioning failed (%s); rolling back %d node(s)",
+                     exc, len(created))
+        _rollback_nodes(session, parent, created)
+        if isinstance(exc, (ProvisioningError, api_client.ApiError)):
+            raise
+        raise ProvisioningError(str(exc)) from exc
     job_id = request["job_id"]
     console_url = (
         f"https://console.cloud.google.com/compute/tpus?project={project}"
@@ -177,7 +220,7 @@ def deploy_job(
     print(f"Job submitted: {job_id}")
     print(f"Your TPU nodes are visible at: {console_url}")
     if stream_logs:
-        _stream_logs(job_id, project, zone)
+        _stream_logs(job_id, project, session=session)
     return {
         "job_id": job_id,
         "nodes": list(request["nodes"]),
@@ -185,6 +228,63 @@ def deploy_job(
         "zone": zone,
         "console_url": console_url,
     }
+
+
+def _await_operation(
+    session, op: dict, node_id: str, *, sleep: Callable[[float], None]
+) -> dict:
+    """Poll a TPU v2 long-running operation until done (bounded)."""
+    name = op.get("name")
+    if not name:
+        # Some fakes/environments return the node body directly.
+        return op
+    for _ in range(_LRO_POLL_ATTEMPTS):
+        if op.get("done"):
+            if "error" in op:
+                raise ProvisioningError(
+                    f"node {node_id} create operation failed: {op['error']}"
+                )
+            return op
+        sleep(_LRO_POLL_INTERVAL_SECONDS)
+        op = session.get(f"{_TPU_API}/{name}")
+    raise ProvisioningError(
+        f"node {node_id} create operation {name!r} not done after "
+        f"{_LRO_POLL_ATTEMPTS * _LRO_POLL_INTERVAL_SECONDS}s"
+    )
+
+
+def _await_node_ready(
+    session, parent: str, node_id: str, *, sleep: Callable[[float], None]
+) -> dict:
+    """Poll the node until state == READY (reference budget 40 x 10 s)."""
+    node = {}
+    for attempt in range(_READY_POLL_ATTEMPTS):
+        node = session.get(f"{_TPU_API}/{parent}/nodes/{node_id}")
+        state = node.get("state")
+        if state == "READY":
+            logger.info("TPU node %s READY", node_id)
+            return node
+        if state in ("PREEMPTED", "TERMINATED"):
+            raise ProvisioningError(
+                f"node {node_id} entered terminal state {state}"
+            )
+        if attempt + 1 < _READY_POLL_ATTEMPTS:
+            sleep(_READY_POLL_INTERVAL_SECONDS)
+    raise ProvisioningError(
+        f"node {node_id} not READY after "
+        f"{_READY_POLL_ATTEMPTS * _READY_POLL_INTERVAL_SECONDS}s "
+        f"(last state: {node.get('state')!r})"
+    )
+
+
+def _rollback_nodes(session, parent: str, node_ids: List[str]) -> None:
+    """Best-effort deletion of partially-provisioned slices."""
+    for node_id in node_ids:
+        try:
+            session.delete(f"{_TPU_API}/{parent}/nodes/{node_id}")
+            logger.info("rolled back TPU node %s", node_id)
+        except Exception:  # noqa: BLE001 — rollback must visit every node
+            logger.exception("rollback of node %s failed", node_id)
 
 
 def delete_job(job_info: dict,
@@ -198,15 +298,64 @@ def delete_job(job_info: dict,
         logger.info("deleted TPU node %s", node_id)
 
 
-def _stream_logs(job_id: str, project: str, zone: str) -> None:
-    """Stream node logs via gcloud (reference shelled out the same way,
-    deploy.py:187-211)."""
-    argv = [
-        "gcloud", "logging", "read",
-        f'resource.type="tpu_worker" AND labels.cloud_tpu_job="{job_id}"',
-        "--project", project, "--format", "value(textPayload)",
-    ]
+_LOGGING_API = "https://logging.googleapis.com/v2"
+
+
+def stream_logs(
+    job_id: str,
+    project: str,
+    *,
+    session: Optional[api_client.GcpApiSession] = None,
+    poll_seconds: float = 10.0,
+    should_stop: Optional[Callable[[], bool]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    out: Callable[[str], None] = print,
+) -> int:
+    """Continuously stream the job's TPU-worker logs (Cloud Logging REST).
+
+    Reference analogue: ``deploy.py:187-211`` shelled out to ``gcloud
+    ai-platform jobs stream-logs`` (blocking follow).  Here the follow loop
+    is framework-owned: poll ``entries:list`` with a timestamp cursor so
+    each round prints only new entries, forever until ``should_stop`` says
+    otherwise (or Ctrl-C).  Returns the number of entries printed.
+    """
+    session = session or api_client.default_session()
+    base_filter = (
+        f'resource.type="tpu_worker" AND labels.cloud_tpu_job="{job_id}"'
+    )
+    cursor: Optional[str] = None
+    printed = 0
     try:
-        subprocess.run(argv, check=False)
-    except FileNotFoundError:
-        logger.warning("gcloud not installed; skipping log streaming")
+        while True:
+            log_filter = base_filter + (
+                f' AND timestamp>"{cursor}"' if cursor else ""
+            )
+            resp = session.post(
+                f"{_LOGGING_API}/entries:list",
+                body={
+                    "resourceNames": [f"projects/{project}"],
+                    "filter": log_filter,
+                    "orderBy": "timestamp asc",
+                    "pageSize": 1000,
+                },
+            )
+            for entry in resp.get("entries", []):
+                payload = entry.get("textPayload")
+                if payload is None:
+                    import json
+
+                    payload = json.dumps(entry.get("jsonPayload", {}))
+                out(payload)
+                printed += 1
+                cursor = entry.get("timestamp", cursor)
+            if should_stop is not None and should_stop():
+                return printed
+            sleep(poll_seconds)
+    except KeyboardInterrupt:
+        logger.info("log streaming interrupted")
+        return printed
+
+
+#: deploy_job's ``stream_logs`` kwarg shadows the function inside its body;
+#: the alias keeps the call site unambiguous.
+_stream_logs = stream_logs

@@ -108,12 +108,14 @@ def encode(
 ):
     """tokens [B, T] -> contextual embeddings [B, T, D]."""
     b, t = tokens.shape
-    x = layers.embedding_apply(params["tok"], tokens, dtype=cfg.dtype)
-    x = x + layers.embedding_apply(
-        params["pos"], jnp.broadcast_to(jnp.arange(t), (b, t)), dtype=cfg.dtype
-    )
+    x = layers.embedding_apply(params["tok"], tokens, dtype=cfg.dtype,
+                               rules=rules)
+    # Positions are always arange: a static slice of the table broadcast
+    # over batch — no gather, nothing for SPMD to rematerialize.
+    x = x + params["pos"]["table"][:t].astype(cfg.dtype)[None, :, :]
     if segment_ids is not None:
-        x = x + layers.embedding_apply(params["seg"], segment_ids, dtype=cfg.dtype)
+        x = x + layers.embedding_apply(params["seg"], segment_ids,
+                                       dtype=cfg.dtype, rules=rules)
     x = layers.layernorm_apply(params["ln_embed"], x)
     x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules)
 
@@ -121,11 +123,15 @@ def encode(
 
     def layer_body(x, lp):
         def proj(p):
-            return layers.dense_apply(p, x).reshape(b, t, h, hd)
+            y = layers.dense_apply(p, x).reshape(b, t, h, hd)
+            return shard_constraint(y, "batch", "seq", "heads", None,
+                                    rules=rules)
 
-        attended = layers.causal_attention(
+        # Pallas flash kernel (padding mask applied in-kernel) on TPU;
+        # the r1 measurement ran the jnp reference path (VERDICT weak #2).
+        attended = layers.sharded_attention(
             proj(lp["att"]["q"]), proj(lp["att"]["k"]), proj(lp["att"]["v"]),
-            mask=attention_mask, causal=False,
+            mask=attention_mask, causal=False, rules=rules,
         )
         att_out = layers.dense_apply(lp["att"]["out"], attended.reshape(b, t, -1))
         x = layers.layernorm_apply(lp["ln1"], x + att_out)

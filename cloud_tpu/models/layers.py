@@ -58,8 +58,25 @@ def embedding_init(rng, vocab: int, dim: int, *, vocab_axis="vocab",
     return {"table": table}, {"table": (vocab_axis, embed_axis)}
 
 
-def embedding_apply(params, token_ids, *, dtype=jnp.float32):
-    return jnp.take(params["table"].astype(dtype), token_ids, axis=0)
+def embedding_apply(params, token_ids, *, dtype=jnp.float32,
+                    rules: ShardingRules = DEFAULT_RULES, mesh=None):
+    """Table lookup with SPMD-friendly sharding.
+
+    The table's dims are param-sharded (vocab over tp, embed over fsdp) but
+    the lookup output wants activation sharding (batch/seq).  Left to
+    itself XLA "involuntarily fully rematerializes" at the gather (observed
+    in the r1 dryrun, spmd_partitioner.cc) — replicate the table explicitly
+    (one clean all-gather, the ZeRO-3 gather-weights-per-use pattern) so
+    the gather partitions by its index dims instead.  ``mesh`` falls back
+    to the global mesh, like every shard_constraint.
+    """
+    table = params["table"].astype(dtype)
+    table = shard_constraint(table, None, None, rules=rules, mesh=mesh)
+    out = jnp.take(table, token_ids, axis=0)
+    if token_ids.ndim == 2:
+        out = shard_constraint(out, "batch", "seq", "act_embed", rules=rules,
+                               mesh=mesh)
+    return out
 
 
 def layernorm_init(dim: int, *, axis: Optional[str] = None):
@@ -116,6 +133,83 @@ def causal_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     from cloud_tpu.ops.flash_attention import _reference
 
     return _reference(q, k, v, causal=causal, mask=mask)
+
+
+def sharded_attention(q, k, v, *, causal: bool,
+                      mask: Optional[jnp.ndarray] = None,
+                      rules: ShardingRules = DEFAULT_RULES, mesh=None):
+    """Mesh-aware attention dispatch over [B, T, H, D] tensors.
+
+    The single routing point shared by CloudLM and BERT:
+
+    - inside a partial-manual region (the pp pipeline body): plain ops —
+      nested shard_maps verify-fail at the sdy level there (their hoisted
+      vjp residuals cross the pp-manual boundary with a mixed-axis-order
+      dim sharding the manual_computation verifier rejects)
+    - ``sp`` > 1 and no mask: ring attention over the sequence axis
+    - mesh present: the Pallas flash kernel under a full-manual shard_map
+      (pallas_call is a custom call GSPMD cannot partition; unwrapped it
+      would replicate every operand)
+    - otherwise: direct dispatch (kernel on TPU, jnp reference elsewhere)
+
+    ``mask`` is a [B, T_k] valid-token padding mask; the flash kernels
+    apply it key-side (flash_attention docstring).  Masked ring attention
+    falls back to GSPMD-partitioned reference ops (the ring fold carries
+    no mask plumbing yet).
+    """
+    from functools import partial
+
+    from jax.sharding import PartitionSpec
+
+    from cloud_tpu import ops
+    from cloud_tpu.parallel import mesh as mesh_lib
+    from cloud_tpu.parallel import sharding as sharding_lib
+    from cloud_tpu.parallel.ring_attention import ring_attention
+
+    mesh = mesh or mesh_lib.get_global_mesh()
+    sp_size = dict(mesh.shape).get(mesh_lib.AXIS_SP, 1) if mesh is not None else 1
+
+    if sharding_lib.manual_context_mesh() is not None:
+        return ops.flash_attention(q, k, v, causal=causal, mask=mask,
+                                   use_pallas=False)
+    if sp_size > 1 and mask is None:
+        batch_axes = rules.assignment("batch")
+        heads_axes = rules.assignment("heads")
+        spec = PartitionSpec(batch_axes, mesh_lib.AXIS_SP, heads_axes, None)
+        return jax.shard_map(
+            partial(ring_attention, axis=mesh_lib.AXIS_SP, causal=causal),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            # The online-softmax accumulators start replicated and become
+            # axis-varying inside the fori_loop; skip VMA carry checking.
+            check_vma=False,
+        )(q, k, v)
+    if mesh is not None and sp_size == 1:
+        batch_axes = rules.assignment("batch")
+        heads_axes = rules.assignment("heads")
+        spec = PartitionSpec(batch_axes, None, heads_axes, None)
+        if mask is None:
+            return jax.shard_map(
+                partial(ops.flash_attention, causal=causal),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+            )(q, k, v)
+        mask_spec = PartitionSpec(batch_axes, None)
+        return jax.shard_map(
+            lambda q_, k_, v_, m_: ops.flash_attention(
+                q_, k_, v_, causal=causal, mask=m_
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec, mask_spec),
+            out_specs=spec,
+        )(q, k, v, mask)
+    # sp>1 with a mask (no ring plumbing), or no mesh at all.
+    return ops.flash_attention(
+        q, k, v, causal=causal, mask=mask,
+        use_pallas=False if (mesh is not None and sp_size > 1) else None,
+    )
 
 
 def attention_block_axes():

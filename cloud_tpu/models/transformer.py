@@ -8,9 +8,10 @@ produces:
 - ``tp``: heads and MLP hidden sharded (kernels' ``heads``/``mlp`` axes)
 - ``fsdp``: parameter ``embed`` axes sharded (ZeRO-3)
 - ``sp`` > 1: attention runs as ring attention over sequence blocks
-- ``pp`` > 1: the scanned layer-stack dim shards over ``pp`` (use rules
-  ``extended(layers="stage")``); upgraded to microbatched pipelining by
-  ``parallel/pipeline.py``
+- ``pp`` > 1 with rules ``extended(layers="pp")``: the layer stack runs as
+  a GPipe microbatched pipeline (``parallel/pipeline.py``) — stage-sharded
+  weights, ``config.num_microbatches`` microbatches shift-registered over
+  the ``pp`` axis via ppermute
 - ``ep`` > 1: MoE expert dim sharded
 
 The reference shipped no models — its golden workloads were user Keras
@@ -27,12 +28,10 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec
 
-from cloud_tpu import ops
 from cloud_tpu.models import layers, moe as moe_lib
 from cloud_tpu.parallel import mesh as mesh_lib
-from cloud_tpu.parallel.ring_attention import ring_attention
+from cloud_tpu.parallel import pipeline as pipeline_lib
 from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules, shard_constraint
 
 
@@ -49,6 +48,9 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     rope_base: float = 10000.0
+    #: Microbatch count for pipeline parallelism (pp > 1); None -> pp size.
+    #: Bubble fraction is (pp-1)/(M+pp-1), so raise this to amortize it.
+    num_microbatches: Optional[int] = None
 
     def scaled(self, **kw) -> "TransformerConfig":
         return dataclasses.replace(self, **kw)
@@ -156,41 +158,81 @@ def _attention(
     k = shard_constraint(k, "batch", "seq", "heads", None, rules=rules, mesh=mesh)
     v = shard_constraint(v, "batch", "seq", "heads", None, rules=rules, mesh=mesh)
 
-    sp_size = mesh.shape.get(mesh_lib.AXIS_SP, 1) if mesh is not None else 1
-    if sp_size > 1:
-        # Sequence blocks are distributed: run the ring.
-        batch_axes = rules.assignment("batch")
-        heads_axes = rules.assignment("heads")
-        spec = PartitionSpec(batch_axes, mesh_lib.AXIS_SP, heads_axes, None)
-        attended = jax.shard_map(
-            partial(ring_attention, axis=mesh_lib.AXIS_SP, causal=True),
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-            # The online-softmax accumulators start replicated and become
-            # axis-varying inside the fori_loop; skip VMA carry checking.
-            check_vma=False,
-        )(q, k, v)
-    elif mesh is not None:
-        # Pallas flash kernel on TPU; jnp reference elsewhere (ops/__init__).
-        # pallas_call is a custom call GSPMD cannot partition — unwrapped
-        # it would replicate the full [B,T,H,D] operands on every device.
-        # shard_map over the batch/heads shards keeps it local, matching
-        # the q/k/v shard_constraints above (seq unsharded since sp==1).
-        batch_axes = rules.assignment("batch")
-        heads_axes = rules.assignment("heads")
-        spec = PartitionSpec(batch_axes, None, heads_axes, None)
-        attended = jax.shard_map(
-            partial(ops.flash_attention, causal=True),
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-        )(q, k, v)
-    else:
-        attended = ops.flash_attention(q, k, v, causal=True)
+    attended = layers.sharded_attention(
+        q, k, v, causal=True, rules=rules, mesh=mesh
+    )
 
     attended = attended.reshape(b, t, h * hd)
     return layers.dense_apply(att_params["out"], attended)
+
+
+def _layer_compute(layer_params, x, aux, *, config, rules, mesh, positions):
+    """One transformer block on (x [B, T, D], aux scalar) — the single
+    source of truth shared by the scanned and pipelined layer stacks."""
+    y = layers.rmsnorm_apply(layer_params["ln1"], x)
+    x = x + _attention(y, layer_params["att"], config, rules, mesh, positions)
+    y = layers.rmsnorm_apply(layer_params["ln2"], x)
+    if config.moe is not None:
+        mlp_out, layer_aux = moe_lib.moe_mlp_apply(
+            layer_params["mlp"], y, config.moe
+        )
+        aux = aux + layer_aux
+    else:
+        mlp_out = layers.mlp_block_apply(layer_params["mlp"], y, rules=rules)
+    x = x + mlp_out
+    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules, mesh=mesh)
+    return x, aux
+
+
+def _is_pipelined(config: TransformerConfig, rules: ShardingRules, mesh) -> bool:
+    if mesh is None:
+        return False
+    if dict(mesh.shape).get(mesh_lib.AXIS_PP, 1) <= 1:
+        return False
+    # .get, not .assignment(): custom rules tables without a "layers" entry
+    # predate pipelining and must keep running the scan path.
+    assignment = rules.rules.get("layers")
+    if assignment is None:
+        return False
+    axes = assignment if isinstance(assignment, tuple) else (assignment,)
+    return mesh_lib.AXIS_PP in axes
+
+
+def _pipelined_stack(params, x, config, rules, mesh):
+    """GPipe microbatched layer stack over the pp axis (pipeline.py)."""
+    b, t, d = x.shape
+    pp = dict(mesh.shape)[mesh_lib.AXIS_PP]
+    m = config.num_microbatches or pp
+    if b % m:
+        raise ValueError(
+            f"Global batch {b} not divisible by num_microbatches={m} "
+            f"(pp={pp}); set config.num_microbatches accordingly."
+        )
+    x_mbs = x.reshape(m, b // m, t, d)
+    x_mbs = shard_constraint(
+        x_mbs, None, "batch", "seq", "act_embed", rules=rules, mesh=mesh
+    )
+    aux_mbs = jnp.zeros((m,), jnp.float32)
+
+    def pipe_layer(layer_params, carry):
+        xc, aux = carry
+        mb, tc = xc.shape[0], xc.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(tc), (mb, tc))
+        return _layer_compute(
+            layer_params, xc, aux, config=config, rules=rules, mesh=mesh,
+            positions=positions,
+        )
+
+    body = jax.checkpoint(pipe_layer) if config.remat else pipe_layer
+    x_mbs, aux_mbs = pipeline_lib.pipeline(
+        body, params["layers"], (x_mbs, aux_mbs), mesh=mesh
+    )
+    x = x_mbs.reshape(b, t, d)
+    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules, mesh=mesh)
+    # Per-microbatch aux losses average to keep pp-independent scale
+    # (gradient-accumulation semantics; batch-coupled aux differs from the
+    # full-batch value by construction, like any microbatched MoE).
+    return x, jnp.sum(aux_mbs) / m
 
 
 def apply(
@@ -204,31 +246,30 @@ def apply(
     """Forward pass: tokens [B, T] -> (logits [B, T, V], aux loss scalar)."""
     mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
     b, t = tokens.shape
-    x = layers.embedding_apply(params["embed"], tokens, dtype=config.dtype)
+    x = layers.embedding_apply(params["embed"], tokens, dtype=config.dtype,
+                               rules=rules, mesh=mesh)
     x = x * math.sqrt(config.dim)
     x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules, mesh=mesh)
-    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
 
-    def layer_body(carry, layer_params):
-        x, aux = carry
-        y = layers.rmsnorm_apply(layer_params["ln1"], x)
-        x = x + _attention(y, layer_params["att"], config, rules, mesh, positions)
-        y = layers.rmsnorm_apply(layer_params["ln2"], x)
-        if config.moe is not None:
-            mlp_out, layer_aux = moe_lib.moe_mlp_apply(
-                layer_params["mlp"], y, config.moe
+    if _is_pipelined(config, rules, mesh):
+        x, aux = _pipelined_stack(params, x, config, rules, mesh)
+    else:
+        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+
+        def layer_body(carry, layer_params):
+            x, aux = carry
+            x, aux = _layer_compute(
+                layer_params, x, aux, config=config, rules=rules, mesh=mesh,
+                positions=positions,
             )
-            aux = aux + layer_aux
-        else:
-            mlp_out = layers.mlp_block_apply(layer_params["mlp"], y, rules=rules)
-        x = x + mlp_out
-        x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules, mesh=mesh)
-        return (x, aux), None
+            return (x, aux), None
 
-    body = layer_body
-    if config.remat:
-        body = jax.checkpoint(layer_body)
-    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+        body = layer_body
+        if config.remat:
+            body = jax.checkpoint(layer_body)
+        (x, aux), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+        )
 
     x = layers.rmsnorm_apply(params["ln_f"], x)
     logits = layers.dense_apply(params["head"], x, dtype=jnp.float32)

@@ -192,8 +192,6 @@ def start_exporter(project: Optional[str] = None, session=None) -> bool:
     def final_flush() -> None:
         sink_json(json.dumps(_filtered_snapshot(_env_allowlist())))
 
-    _final_flush = final_flush
-
     if metrics_lib.backend() == "native":
         lib = metrics_lib._get_registry()._lib  # type: ignore[union-attr]
         SINK = ctypes.CFUNCTYPE(None, ctypes.c_char_p)
@@ -209,6 +207,10 @@ def start_exporter(project: Optional[str] = None, session=None) -> bool:
         # enable gate above and the native gate agree.
         lib.ctpu_exporter_config_reload()
         _started = bool(lib.ctpu_exporter_start())
+        # Arm the final flush only for a live exporter: a failed start must
+        # not leave stop_exporter() posting a snapshot through an exporter
+        # that never ran.
+        _final_flush = final_flush if _started else None
         return _started
 
     interval = int(os.environ.get("CLOUD_TPU_MONITORING_INTERVAL", "10"))
@@ -222,6 +224,7 @@ def start_exporter(project: Optional[str] = None, session=None) -> bool:
     _python_thread = threading.Thread(target=loop, daemon=True)
     _python_thread.start()
     _started = True
+    _final_flush = final_flush
     return True
 
 

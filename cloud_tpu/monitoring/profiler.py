@@ -138,7 +138,11 @@ def save_device_memory_profile(path: Optional[str] = None) -> str:
     capture includes a memory-viewer plane.
     """
     path = path or os.path.join(default_logdir(), "memory.prof")
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if "://" not in path:
+        # Only local paths need (or tolerate) makedirs; for gs:// the
+        # underlying writer owns path creation — a naive makedirs would
+        # create a bogus local "gs:/..." directory tree.
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     jax.profiler.save_device_memory_profile(path)
     return path
 

@@ -30,6 +30,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30  # finite: fully-masked rows softmax to zeros, not NaN
@@ -62,8 +63,14 @@ def _reference(q, k, v, *, causal, mask):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_mask):
+    if use_mask:
+        (q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+        mask_ref = None
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -97,6 +104,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 jnp.int32, s.shape, 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            # Key-side padding mask [block_k] (nonzero = valid token),
+            # broadcast over query rows — matches the reference path's
+            # mask[:, None, None, :] semantics.
+            s = jnp.where(mask_ref[0][None, :] != 0, s, NEG_INF)
 
         m_prev = m_scr[:, :1]  # [block_q, 1] (value replicated over lanes)
         l_prev = l_scr[:, :1]
@@ -139,8 +151,9 @@ def _check_divisible(t, block_q, block_k):
         )
 
 
-def _fwd_pallas(q, k, v, *, causal, block_q, block_k, interpret):
-    """q,k,v: [B, H, T, D] -> (out [B, H, T, D], lse [B, H, T, 1])."""
+def _fwd_pallas(q, k, v, mask, *, causal, block_q, block_k, interpret):
+    """q,k,v: [B, H, T, D]; mask: [B, T] i32 or None ->
+    (out [B, H, T, D], lse [B, H, T, 1])."""
     b, h, t, d = q.shape
     _check_divisible(t, block_q, block_k)
     nq, nk = t // block_q, t // block_k
@@ -148,14 +161,21 @@ def _fwd_pallas(q, k, v, *, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, use_mask=mask is not None,
     )
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
     kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0))
+    in_specs = [qspec, kspec, kspec]
+    operands = [q, k, v]
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, block_k), lambda b_, h_, qi, ki: (b_, ki))
+        )
+        operands.append(mask)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
-        in_specs=[qspec, kspec, kspec],
+        in_specs=in_specs,
         out_specs=[
             qspec,
             pl.BlockSpec(
@@ -173,7 +193,7 @@ def _fwd_pallas(q, k, v, *, causal, block_q, block_k, interpret):
         ],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return out, lse
 
 
@@ -196,8 +216,14 @@ def _compiler_params():
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, use_mask):
+    if use_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+        mask_ref = None
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -226,6 +252,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[0][None, :] != 0, s, NEG_INF)
         p = jnp.exp(s - lse)  # [block_q, block_k]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -242,9 +270,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, block_q, block_k):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, use_mask):
+    if use_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        mask_ref = None
     ki, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -275,6 +308,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            # This grid walks key blocks in dim 2: the mask block is the
+            # one covering this kernel's key rows (index i, not j).
+            s = jnp.where(mask_ref[0][None, :] != 0, s, NEG_INF)
         p = jnp.exp(s - lse)  # [block_q, block_k]
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -296,11 +333,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(q, k, v, do, out, lse, *, causal, block_q, block_k, interpret):
+def _bwd_pallas(q, k, v, mask, do, out, lse, *, causal, block_q, block_k,
+                interpret):
     b, h, t, d = q.shape
     _check_divisible(t, block_q, block_k)
     nq, nk = t // block_q, t // block_k
     scale = 1.0 / math.sqrt(d)
+    use_mask = mask is not None
     # delta_i = rowsum(dO_i * O_i): elementwise, XLA fuses it; no kernel.
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
@@ -313,19 +352,26 @@ def _bwd_pallas(q, k, v, do, out, lse, *, causal, block_q, block_k, interpret):
         (1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0)
     )
 
+    dq_in_specs = [qspec, kspec_i, kspec_i, qspec, rowspec, rowspec]
+    dq_operands = [q, k, v, do, lse, delta]
+    if use_mask:
+        dq_in_specs.append(
+            pl.BlockSpec((1, block_k), lambda b_, h_, i, j: (b_, j))
+        )
+        dq_operands.append(mask)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, use_mask=use_mask,
         ),
         grid=(b, h, nq, nk),
-        in_specs=[qspec, kspec_i, kspec_i, qspec, rowspec, rowspec],
+        in_specs=dq_in_specs,
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
         scratch_shapes=[_vmem((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)[0]
+    )(*dq_operands)[0]
 
     # dK/dV: grid walks key blocks in the parallel dims, query blocks in the
     # arbitrary (accumulating) dim.
@@ -334,13 +380,20 @@ def _bwd_pallas(q, k, v, do, out, lse, *, causal, block_q, block_k, interpret):
     rowspec_j = pl.BlockSpec(
         (1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, j, 0)
     )
+    dkv_in_specs = [qspec_j, kspec_o, kspec_o, qspec_j, rowspec_j, rowspec_j]
+    dkv_operands = [q, k, v, do, lse, delta]
+    if use_mask:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, block_k), lambda b_, h_, i, j: (b_, i))
+        )
+        dkv_operands.append(mask)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, use_mask=use_mask,
         ),
         grid=(b, h, nk, nq),
-        in_specs=[qspec_j, kspec_o, kspec_o, qspec_j, rowspec_j, rowspec_j],
+        in_specs=dkv_in_specs,
         out_specs=[kspec_o, kspec_o],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -352,7 +405,7 @@ def _bwd_pallas(q, k, v, do, out, lse, *, causal, block_q, block_k, interpret):
         ],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
@@ -361,30 +414,35 @@ def _bwd_pallas(q, k, v, do, out, lse, *, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, mask, causal, block_q, block_k, interpret):
     out, _ = _fwd_pallas(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        q, k, v, mask, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, mask, causal, block_q, block_k, interpret):
     out, lse = _fwd_pallas(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        q, k, v, mask, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, mask, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v, out, lse = residuals
+    q, k, v, mask, out, lse = residuals
     dq, dk, dv = _bwd_pallas(
-        q, k, v, g, out, lse, causal=causal, block_q=block_q,
+        q, k, v, mask, g, out, lse, causal=causal, block_q=block_q,
         block_k=block_k, interpret=interpret,
     )
-    return dq, dk, dv
+    # The i32 mask's cotangent is float0 (integer operands carry no grad).
+    dmask = (
+        None if mask is None
+        else np.zeros(mask.shape, jax.dtypes.float0)
+    )
+    return dq, dk, dv, dmask
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -429,21 +487,29 @@ def flash_attention(
     """Attention over [B, T, H, D] tensors, differentiable.
 
     ``use_pallas=None`` auto-dispatches: kernels on TPU when shapes tile,
-    reference jnp otherwise.  ``mask`` (a [B, T_k] valid-token mask) always
-    routes to the reference path.  ``interpret=True`` runs the kernels in
-    the Pallas interpreter (CPU tests of kernel logic).
+    reference jnp otherwise.  ``mask`` is a [B, T_k] valid-token padding
+    mask (bool/int; nonzero = attend) applied key-side inside the kernels —
+    fully-masked query rows produce uniform garbage (finite NEG_INF
+    semantics), which the caller's loss mask must drop, matching the
+    reference path.  ``interpret=True`` runs the kernels in the Pallas
+    interpreter (CPU tests of kernel logic).
     """
     fitted_q = _fit_block(q.shape[1], block_q)
     fitted_k = _fit_block(k.shape[1], block_k)
+    mask_ok = mask is None or (
+        mask.ndim == 2
+        and mask.shape[0] == q.shape[0]
+        and mask.shape[1] == k.shape[1]
+    )
     if use_pallas is None:
         use_pallas = (
             jax.default_backend() == "tpu"
-            and mask is None
+            and mask_ok
             and _kernel_eligible(q, k, fitted_q, fitted_k)
         )
     if interpret:
         use_pallas = True
-    if not use_pallas or mask is not None:
+    if not use_pallas or not mask_ok:
         return _reference(q, k, v, causal=causal, mask=mask)
     # Requested blocks are upper bounds: run with the largest aligned
     # divisor of T at or below them.  No aligned divisor (forced kernel
@@ -454,5 +520,6 @@ def flash_attention(
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    mask_i32 = None if mask is None else mask.astype(jnp.int32)
+    out = _flash(qt, kt, vt, mask_i32, causal, block_q, block_k, interpret)
     return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,204 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+The layer stack is divided into ``pp`` contiguous stages (the stacked
+params' leading dim shards over ``pp``); the batch is divided into M
+microbatches that flow through the stages as a shift register: at tick t,
+stage s runs microbatch ``t - s`` and hands its activations to stage s+1
+over ICI (``ppermute``).  Total ticks = M + pp - 1, so the pipeline bubble
+is ``(pp - 1) / (M + pp - 1)`` of the step — raise ``num_microbatches`` to
+amortize it.
+
+Implementation: a *partial-manual* ``shard_map`` — manual over ``pp`` only
+(``axis_names={"pp"}``), while dp/fsdp/tp/sp/ep stay under automatic GSPMD
+partitioning.  The stage body is therefore the ordinary model layer code:
+its einsums still shard over tp/ep, its attention still runs its own inner
+``shard_map`` (over the remaining auto axes via the context's abstract
+mesh), and batch dims stay sharded over dp×fsdp.  Gradients flow through
+the schedule because every schedule op (``ppermute``, dynamic slices,
+``psum``) is differentiable — the backward pass is the mirrored pipeline.
+
+The reference has no pipeline analogue (SURVEY.md §2.6: it tops out at data
+parallelism); this is TPU-native capability the rebuild adds, fulfilling
+the ``pp`` axis contract declared in ``parallel/mesh.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from cloud_tpu.parallel import mesh as mesh_lib
+
+
+def _tree_where(pred, on_true, on_false):
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), on_true, on_false
+    )
+
+
+def _psum_f32(x, axis: str):
+    """psum that dodges an XLA crash: all-reduce over a partially-manual
+    axis CHECK-fails on sub-f32 dtypes ("Invalid binary instruction opcode
+    copy", hlo_instruction.cc) — reduce in f32 and cast back."""
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return jax.lax.psum(x.astype(jnp.float32), axis).astype(x.dtype)
+    return jax.lax.psum(x, axis)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _pvary_safe(x, axis: str):
+    """``pcast``-to-varying whose transpose reduces via :func:`_psum_f32`
+    (the default transpose emits a raw psum, hitting the same sub-f32 XLA
+    crash)."""
+    return jax.lax.pcast(x, (axis,), to="varying")
+
+
+def _pvary_safe_fwd(x, axis):
+    return _pvary_safe(x, axis), None
+
+
+def _pvary_safe_bwd(axis, _, g):
+    return (_psum_f32(g, axis),)
+
+
+_pvary_safe.defvjp(_pvary_safe_fwd, _pvary_safe_bwd)
+
+
+def num_stages(mesh, axis: str = mesh_lib.AXIS_PP) -> int:
+    if mesh is None:
+        return 1
+    return dict(mesh.shape).get(axis, 1)
+
+
+def pipeline(
+    layer_fn: Callable[[Any, Any], Any],
+    stacked_params,
+    microbatches,
+    *,
+    mesh,
+    axis: str = mesh_lib.AXIS_PP,
+):
+    """Run microbatches through a pipelined layer stack.
+
+    Args:
+      layer_fn: ``layer_fn(one_layer_params, carry) -> carry`` — applies a
+        single layer to one microbatch's carry pytree.
+      stacked_params: pytree whose leaves have leading dim L (the layer
+        count, divisible by the ``pp`` size); sharded over ``axis`` on that
+        dim, so each stage holds L/pp contiguous layers.
+      microbatches: pytree whose leaves have leading dim M (the microbatch
+        count); leaf [m] is microbatch m's slice of the carry.
+      mesh: the active Mesh (must contain ``axis``).
+
+    Returns:
+      A pytree congruent with ``microbatches``: each microbatch's carry
+      after all L layers.
+    """
+    pp = num_stages(mesh, axis)
+    if pp <= 1:
+        return _sequential(layer_fn, stacked_params, microbatches)
+
+    m = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] % pp:
+            raise ValueError(
+                f"Layer count {leaf.shape[0]} not divisible by pp={pp}"
+            )
+
+    def body(params, mbs):
+        stage = jax.lax.axis_index(axis)
+        nticks = m + pp - 1
+        # Everything entering the tick loop must already be pp-varying so
+        # the fori_loop carry keeps a consistent varying-manual-axes type.
+        mbs = jax.tree_util.tree_map(lambda x: _pvary_safe(x, axis), mbs)
+
+        def one_stage(carry):
+            def scan_body(c, p):
+                return layer_fn(p, c), None
+
+            out, _ = jax.lax.scan(scan_body, carry, params)
+            return out
+
+        def mb_at(t):
+            # Clamped read: ticks >= M re-read the last microbatch; their
+            # results land past the output window (the scratch row).
+            idx = jnp.minimum(t, m - 1)
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_index_in_dim(
+                    x, idx, 0, keepdims=False
+                ),
+                mbs,
+            )
+
+        carry0 = jax.tree_util.tree_map(
+            lambda x: _pvary_safe(jnp.zeros(x.shape[1:], x.dtype), axis),
+            mbs,
+        )
+        # Output buffer with one scratch row (index M): bubble-tick writes
+        # are routed there instead of guarding with a whole-buffer select.
+        out0 = jax.tree_util.tree_map(
+            lambda x: _pvary_safe(jnp.zeros((m + 1,) + x.shape[1:], x.dtype), axis),
+            mbs,
+        )
+
+        def tick(t, state):
+            carry, outputs = state
+            inp = _tree_where(stage == 0, mb_at(t), carry)
+            y = one_stage(inp)
+            out_idx = t - (pp - 1)
+            store = jnp.where((out_idx >= 0) & (out_idx < m), out_idx, m)
+            outputs = jax.tree_util.tree_map(
+                lambda buf, v: jax.lax.dynamic_update_index_in_dim(
+                    buf, v, store, 0
+                ),
+                outputs,
+                y,
+            )
+            carry = jax.tree_util.tree_map(
+                lambda v: jax.lax.ppermute(
+                    v, axis, [(i, (i + 1) % pp) for i in range(pp)]
+                ),
+                y,
+            )
+            return carry, outputs
+
+        _, outputs = jax.lax.fori_loop(0, nticks, tick, (carry0, out0))
+        outputs = jax.tree_util.tree_map(lambda x: x[:m], outputs)
+        # Only the final stage holds real results; zero the rest and
+        # all-reduce so every stage returns the same (replicated) value.
+        outputs = _tree_where(
+            stage == pp - 1,
+            outputs,
+            jax.tree_util.tree_map(jnp.zeros_like, outputs),
+        )
+
+        return jax.tree_util.tree_map(
+            lambda x: _psum_f32(x, axis), outputs
+        )
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(PartitionSpec(axis), PartitionSpec()),
+        out_specs=PartitionSpec(),
+        axis_names={axis},
+    )(stacked_params, microbatches)
+
+
+def _sequential(layer_fn, stacked_params, microbatches):
+    """pp=1 degenerate case: one traced layer-stack scan, mapped over the
+    microbatch dim (lax.map keeps the trace single, unlike a Python loop
+    which would compile the stack M times)."""
+
+    def scan_body(carry, p):
+        return layer_fn(p, carry), None
+
+    def run_one(mb):
+        out, _ = jax.lax.scan(scan_body, mb, stacked_params)
+        return out
+
+    return jax.lax.map(run_one, microbatches)

@@ -89,6 +89,22 @@ def named_sharding(
     return NamedSharding(mesh, rules.spec(*logical_axes))
 
 
+def manual_context_mesh():
+    """The enclosing partial-manual shard_map's abstract mesh, or None.
+
+    Inside a partial-manual region (e.g. the pipeline's ``pp``-manual body,
+    parallel/pipeline.py) every sharding construct must be built against the
+    *abstract* context mesh — a concrete Mesh there raises a mesh-mismatch
+    error from XLA's sharding checks.
+    """
+    am = jax.sharding.get_abstract_mesh()
+    if am is not None and not am.empty and any(
+        t == jax.sharding.AxisType.Manual for t in am.axis_types
+    ):
+        return am
+    return None
+
+
 def shard_constraint(
     x,
     *logical_axes: Optional[str],
@@ -98,10 +114,16 @@ def shard_constraint(
     """``with_sharding_constraint`` by logical axes, inside jit.
 
     No-op when no mesh is active (single-device eager use), so model code is
-    unconditional.
+    unconditional.  Inside a partial-manual shard_map region the constraint
+    binds to the abstract context mesh (specs there may only name its Auto
+    axes; the rules tables never route activations onto ``pp``, the one
+    manual axis in practice).
     """
     mesh = mesh or mesh_lib.get_global_mesh()
     if mesh is None or mesh.empty:
         return x
     spec = rules.spec(*logical_axes)
+    am = manual_context_mesh()
+    if am is not None:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(am, spec))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

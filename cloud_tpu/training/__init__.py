@@ -14,13 +14,23 @@ from cloud_tpu.training.train import (
     make_train_step,
     param_shardings,
 )
-from cloud_tpu.training.trainer import Callback, History, Trainer
+from cloud_tpu.training.trainer import (
+    Callback,
+    EarlyStopping,
+    History,
+    LambdaCallback,
+    ProgressLogger,
+    Trainer,
+)
 
 __all__ = [
     "TrainState",
     "Trainer",
     "Callback",
+    "EarlyStopping",
     "History",
+    "LambdaCallback",
+    "ProgressLogger",
     "create_sharded_state",
     "make_train_step",
     "make_eval_step",

@@ -150,12 +150,30 @@ def make_eval_step(loss_fn: Callable[..., Tuple[jnp.ndarray, Dict]]):
 def shard_batch(batch, mesh: Optional[Mesh],
                 rules: ShardingRules = DEFAULT_RULES,
                 batch_axis: str = "batch"):
-    """Place a host-local batch pytree onto the mesh, sharded on dim 0."""
+    """Place a batch pytree onto the mesh, sharded on dim 0.
+
+    Single-process: ``batch`` is the global batch; a plain sharded
+    device_put.  Multi-process: ``batch`` is this host's *local* slice of
+    the global batch (each host loads only its own data — no host ever
+    materializes the global batch), assembled into one global jax.Array via
+    ``make_array_from_process_local_data``.  The reference's analogue is
+    MWMS auto-sharding the per-worker dataset; at pod scale the
+    all-on-every-host alternative would OOM the hosts.
+    """
     if mesh is None:
         return batch
 
+    import numpy as np
+
+    multiprocess = jax.process_count() > 1
+
     def place(x):
         spec = rules.spec(*([batch_axis] + [None] * (x.ndim - 1)))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        sharding = NamedSharding(mesh, spec)
+        if multiprocess:
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            )
+        return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(place, batch)

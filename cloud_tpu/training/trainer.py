@@ -72,6 +72,14 @@ class EarlyStopping(Callback):
     ``patience`` counts non-improving epochs, ``restore_best_state``
     reinstates the best TrainState on stop (host copy, so it survives
     donated device buffers).
+
+    ``restore_best_state`` snapshots per *improving* epoch: single-process
+    states are gathered to host RAM (one full host copy each time, sparing
+    HBM); multi-process pod-sharded states are NOT host-gatherable
+    (device_get raises on non-addressable shards), so there the snapshot is
+    an on-device copy — one extra state replica of HBM while training.
+    Either way the restore re-commits the exact shardings it captured, so
+    subsequent evaluate/checkpoint calls see an identically-placed state.
     """
 
     def __init__(self, monitor: str = "val_loss", *, min_delta: float = 0.0,
@@ -95,6 +103,7 @@ class EarlyStopping(Callback):
         self._best = -float("inf")
         self._wait = 0
         self._best_state = None
+        self._best_shardings = None
         self.stopped_epoch = None
 
     def on_epoch_end(self, epoch, logs, trainer):
@@ -109,7 +118,25 @@ class EarlyStopping(Callback):
             self._best = current
             self._wait = 0
             if self.restore_best_state:
-                self._best_state = jax.device_get(trainer.state)
+                # Snapshot the layout alongside the values: a bare
+                # device_put on restore would commit everything replicated
+                # on the default device, silently dropping the mesh layout
+                # (and risking host/device OOM for fsdp-sharded states).
+                self._best_shardings = jax.tree_util.tree_map(
+                    lambda x: x.sharding, trainer.state
+                )
+                fully_addressable = all(
+                    x.is_fully_addressable
+                    for x in jax.tree_util.tree_leaves(trainer.state)
+                )
+                if fully_addressable:
+                    self._best_state = jax.device_get(trainer.state)
+                else:
+                    # Pod-sharded: host gather would raise; keep a device
+                    # copy (sharding rides along, survives donation).
+                    self._best_state = jax.tree_util.tree_map(
+                        lambda x: x.copy(), trainer.state
+                    )
         else:
             self._wait += 1
             if self._wait > self.patience:
@@ -118,7 +145,13 @@ class EarlyStopping(Callback):
 
     def on_train_end(self, trainer):
         if self.restore_best_state and self._best_state is not None:
-            trainer.state = jax.device_put(self._best_state)
+            leaves = jax.tree_util.tree_leaves(self._best_state)
+            if leaves and isinstance(leaves[0], jax.Array):
+                trainer.state = self._best_state  # device copy, layout intact
+            else:
+                trainer.state = jax.device_put(
+                    self._best_state, self._best_shardings
+                )
 
 
 class LambdaCallback(Callback):

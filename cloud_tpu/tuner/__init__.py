@@ -10,6 +10,7 @@ without any cloud dependency — the offline analogue of the reference's
 multiprocessing-Pool integration test (tuner_integration_test.py:283-296).
 """
 
+from cloud_tpu.tuner.dispatch import dispatch_search
 from cloud_tpu.tuner.engine import Objective, Trial, TrialStatus, Tuner
 from cloud_tpu.tuner.hyperparameters import HyperParameters
 from cloud_tpu.tuner.study_service import LocalStudyService
@@ -24,4 +25,5 @@ __all__ = [
     "Trial",
     "TrialStatus",
     "Tuner",
+    "dispatch_search",
 ]

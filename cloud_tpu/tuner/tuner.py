@@ -73,6 +73,11 @@ class CloudOracle(Oracle):
     def create_trial(self, tuner_id: str) -> Optional[Trial]:
         if self._created >= self.max_trials:
             return None
+        # Study-wide cap (reference tuner.py:143-158): the budget bounds the
+        # STUDY, not each worker — N workers with only local counters would
+        # run up to N x max_trials trials between them.
+        if len(self.service.list_trials()) >= self.max_trials:
+            return None
         suggestion = self.service.get_suggestion(client_id=tuner_id)
         if suggestion is None:
             return None

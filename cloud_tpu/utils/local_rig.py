@@ -34,6 +34,80 @@ def virtual_mesh_env(
     return env
 
 
+def launch_process_fleet(
+    num_processes: int = 2,
+    *,
+    devices_per_process: int = 2,
+    module: str = "cloud_tpu.parallel.selfcheck",
+    extra_env: Optional[Dict[str, str]] = None,
+    timeout: int = 300,
+):
+    """Spawn ``num_processes`` REAL OS processes forming one
+    jax.distributed job over the ``CLOUD_TPU_*`` env contract.
+
+    This is the multi-process rig VERDICT r1 called for: every prior
+    "multi-chip" test was one process with 8 virtual devices, which can
+    never catch a broken coordinator handshake (whose failure mode is a
+    hang — SURVEY.md §7).  Each process runs ``python -m <module>`` with
+    a distinct ``CLOUD_TPU_PROCESS_ID``; the OS-level timeout converts
+    any hang into a visible failure.
+
+    Returns a list of ``subprocess.CompletedProcess`` in rank order.
+    """
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for rank in range(num_processes):
+        env = virtual_mesh_env(
+            devices_per_process,
+            {
+                "CLOUD_TPU_COORDINATOR": f"localhost:{port}",
+                "CLOUD_TPU_NUM_PROCESSES": str(num_processes),
+                "CLOUD_TPU_PROCESS_ID": str(rank),
+                "CLOUD_TPU_SELFCHECK_FORCE_CPU": "1",
+                **(extra_env or {}),
+            },
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", module],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    # Drain every rank's pipes CONCURRENTLY: ranks run in lockstep through
+    # collectives, so a sequential drain would deadlock the moment any
+    # later rank fills its ~64KB pipe buffer while rank 0 is still being
+    # waited on.
+    from concurrent.futures import ThreadPoolExecutor
+
+    def drain(proc):
+        try:
+            out, err = proc.communicate(timeout=timeout)
+            return subprocess.CompletedProcess(
+                proc.args, proc.returncode, out, err
+            )
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+            return subprocess.CompletedProcess(proc.args, -9, out, err)
+
+    try:
+        with ThreadPoolExecutor(max_workers=num_processes) as pool:
+            results = list(pool.map(drain, procs))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+    return results
+
+
 def run_bootstrap(
     entry_point: str,
     *,

@@ -48,19 +48,30 @@ def hypermodel(hp):
     return t
 
 
-def main():
+def main(argv=None):
+    # dispatch_search appends --study-id/--tuner-id (tuner/dispatch.py
+    # worker contract); env vars remain the manual override.
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--study-id",
+                        default=os.environ.get("STUDY_ID", "mnist_hp_study"))
+    parser.add_argument("--tuner-id",
+                        default=os.environ.get("TUNER_ID", "tuner0"))
+    args = parser.parse_args(argv)
+
     max_trials = int(os.environ.get("TUNER_EXAMPLE_MAX_TRIALS", "4"))
     study_dir = os.environ.get("TUNER_EXAMPLE_STUDY_DIR") or tempfile.mkdtemp(
         prefix="tuner_example_"
     )
-    service = tuner_lib.LocalStudyService("mnist_hp_study", study_dir)
+    service = tuner_lib.LocalStudyService(args.study_id, study_dir)
     t = tuner_lib.CloudTuner(
         hypermodel,
         service,
         objective="loss",
         hyperparameters=build_hyperparameters(),
         max_trials=max_trials,
-        tuner_id=os.environ.get("TUNER_ID", "tuner0"),
+        tuner_id=args.tuner_id,
     )
     t.search(train_data=make_dataset(), epochs=1)
 

@@ -298,6 +298,101 @@ class TestTrainerProtocol:
         assert int(tr2.state.step) == 1
 
 
+class TestEarlyStopping:
+    """ADVICE r1: EarlyStopping semantics incl. the sharded-state restore."""
+
+    class _FakeTrainer:
+        def __init__(self, state=None):
+            self.state = state
+            self.stop_training = False
+
+    def _run(self, cb, values, trainer=None):
+        trainer = trainer or self._FakeTrainer()
+        cb.on_train_begin(trainer)
+        for epoch, v in enumerate(values):
+            cb.on_epoch_end(epoch, {cb.monitor: v}, trainer)
+            if trainer.stop_training:
+                break
+        cb.on_train_end(trainer)
+        return trainer
+
+    def test_min_mode_stops_after_patience(self):
+        from cloud_tpu.training import EarlyStopping
+
+        cb = EarlyStopping("loss", mode="min", patience=1)
+        tr = self._run(cb, [3.0, 2.0, 2.5, 2.6, 1.0])
+        assert tr.stop_training
+        assert cb.stopped_epoch == 3  # two non-improving epochs after best
+
+    def test_auto_mode_maximizes_accuracy(self):
+        from cloud_tpu.training import EarlyStopping
+
+        cb = EarlyStopping("val_accuracy", patience=0)
+        tr = self._run(cb, [0.5, 0.7, 0.6])
+        assert cb._sign == 1.0
+        assert tr.stop_training and cb.stopped_epoch == 2
+
+    def test_min_delta_counts_marginal_gains_as_stalls(self):
+        from cloud_tpu.training import EarlyStopping
+
+        cb = EarlyStopping("loss", mode="min", min_delta=0.5, patience=0)
+        tr = self._run(cb, [3.0, 2.8, 2.7])  # improvements < 0.5
+        assert tr.stop_training and cb.stopped_epoch == 1
+
+    def test_missing_metric_is_tolerated(self):
+        from cloud_tpu.training import EarlyStopping
+
+        cb = EarlyStopping("val_loss", patience=0)
+        trainer = self._FakeTrainer()
+        cb.on_train_begin(trainer)
+        cb.on_epoch_end(0, {"loss": 1.0}, trainer)
+        assert not trainer.stop_training
+
+    def test_restore_best_state_preserves_values_and_shardings(self):
+        from cloud_tpu.training import EarlyStopping
+
+        cfg = mnist.MnistConfig(hidden_dim=16)
+        mesh = parallel.MeshSpec({"fsdp": 8}).build()
+        logical_axes = mnist.param_logical_axes(cfg)
+        with parallel.use_mesh(mesh):
+            state = create_sharded_state(
+                jax.random.PRNGKey(0),
+                functools.partial(mnist.init, config=cfg),
+                optax.adam(1e-3),
+                mesh,
+                logical_axes=logical_axes,
+            )
+        trainer = self._FakeTrainer(state)
+        best_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding, state
+        )
+        best_host = jax.device_get(state)
+
+        cb = EarlyStopping("loss", mode="min", patience=0,
+                           restore_best_state=True)
+        cb.on_train_begin(trainer)
+        cb.on_epoch_end(0, {"loss": 1.0}, trainer)  # best snapshot here
+        # Degrade the live state, then stall out.
+        trainer.state = jax.tree_util.tree_map(lambda x: x + 1, state)
+        cb.on_epoch_end(1, {"loss": 2.0}, trainer)
+        cb.on_train_end(trainer)
+
+        assert trainer.stop_training and cb.stopped_epoch == 1
+        restored_host = jax.device_get(trainer.state)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            restored_host, best_host,
+        )
+        restored_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding, trainer.state
+        )
+        flat_r = jax.tree_util.tree_leaves(restored_shardings)
+        flat_b = jax.tree_util.tree_leaves(best_shardings)
+        assert all(r == b for r, b in zip(flat_r, flat_b))
+
+
 class TestCheckpoint:
     def test_save_restore_round_trip(self, tmp_path):
         from cloud_tpu.training.checkpoint import CheckpointManager

@@ -68,6 +68,48 @@ class TestFlashAttentionForward:
         ref = _reference(q, k, v, causal=True, mask=mask)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_kernel_applies_padding_mask(self, causal):
+        # r2: the kernels apply [B, T_k] padding masks in-VMEM (BERT's
+        # fine-tune path); previously any mask forced the reference path.
+        q, k, v = make_qkv(t=128)
+        mask = jnp.ones((2, 128), bool).at[0, 96:].set(False).at[1, 64:].set(False)
+        out = flash_attention(q, k, v, causal=causal, mask=mask,
+                              interpret=True)
+        ref = _reference(q, k, v, causal=causal, mask=mask)
+        # Compare only valid query rows: fully-masked rows are documented
+        # as garbage (finite NEG_INF semantics) on both paths.
+        np.testing.assert_allclose(
+            np.asarray(out)[0, :96], np.asarray(ref)[0, :96],
+            atol=5e-4, rtol=1e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out)[1, :64], np.asarray(ref)[1, :64],
+            atol=5e-4, rtol=1e-3,
+        )
+
+    def test_kernel_mask_grads_match_reference(self):
+        q, k, v = make_qkv(t=128)
+        mask = jnp.ones((2, 128), bool).at[:, 96:].set(False)
+        row_mask = mask.astype(jnp.float32)[:, :, None, None]
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, causal=False, mask=mask,
+                                  interpret=True)
+            return jnp.sum((out * row_mask) ** 2)
+
+        def loss_ref(q, k, v):
+            out = _reference(q, k, v, causal=False, mask=mask)
+            return jnp.sum((out * row_mask) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g_flash, g_ref):
+            np.testing.assert_allclose(
+                a, b, atol=5e-4, rtol=1e-3,
+                err_msg=f"masked grad mismatch for {name}",
+            )
+
 
 class TestFlashAttentionBackward:
     def test_grads_match_reference(self):
