@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -37,6 +38,16 @@ NEG_INF = -1e30  # finite: fully-masked rows softmax to zeros, not NaN
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
+
+#: Auto-dispatch (``use_pallas=None``) takes the kernel only at T >= this.
+#: Measured on TPU v5e (scripts/attn_crossover.py, value+grad, steady
+#: state): XLA's fused attention is ~1.15-1.25x faster at T in [256, 512]
+#: (the whole O(T^2) score tensor still fits cache-friendly tiles there),
+#: while the kernel wins 1.38x at 1024, 1.45x at 2048, 1.61x at 4096 — and
+#: is O(T) in memory where XLA materializes the [B,H,T,T] scores.  Callers
+#: that need the kernel below the threshold (masked long-tail, tests) pass
+#: ``use_pallas=True`` explicitly.
+MIN_SEQ_LEN_FOR_KERNEL = int(os.environ.get("CLOUD_TPU_FLASH_MIN_SEQ", 1024))
 
 
 # ---------------------------------------------------------------------------
@@ -505,6 +516,7 @@ def flash_attention(
         use_pallas = (
             jax.default_backend() == "tpu"
             and mask_ok
+            and q.shape[1] >= MIN_SEQ_LEN_FOR_KERNEL
             and _kernel_eligible(q, k, fitted_q, fitted_k)
         )
     if interpret:

@@ -1,8 +1,9 @@
 """Minimal input pipelines: in-memory arrays and synthetic data.
 
-The at-scale TFRecord/GCS pipeline lives in ``cloud_tpu/training/records.py``
-(BASELINE config 5); this module covers the in-memory workloads the
-reference's golden scripts used (keras.datasets arrays).
+The at-scale TFRecord/GCS streaming pipeline is ``records.py`` (BASELINE
+config 5: TFRecord wire framing, per-host shards, background prefetch);
+this module covers the in-memory workloads the reference's golden scripts
+used (keras.datasets arrays).
 """
 
 from __future__ import annotations

@@ -170,6 +170,11 @@ def shard_batch(batch, mesh: Optional[Mesh],
     def place(x):
         spec = rules.spec(*([batch_axis] + [None] * (x.ndim - 1)))
         sharding = NamedSharding(mesh, spec)
+        if isinstance(x, jax.Array) and x.sharding == sharding:
+            # Already placed (e.g. by records.prefetch_to_device's background
+            # thread); re-placing a multiprocess array would even fail, since
+            # np.asarray can't read non-addressable shards.
+            return x
         if multiprocess:
             return jax.make_array_from_process_local_data(
                 sharding, np.asarray(x)

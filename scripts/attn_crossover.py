@@ -1,0 +1,51 @@
+"""Find the sequence-length crossover where the Pallas flash kernel beats
+XLA's fused reference attention on this chip.  Prints one JSON line per
+(T, path) with train-relevant value+grad timing."""
+
+import functools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+from cloud_tpu.ops.flash_attention import flash_attention
+
+
+def bench(t, use_pallas, b=8, h=12, d=64, iters=20):
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(key, (b, t, h, d), jnp.bfloat16)
+               for key in keys)
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, causal=False, use_pallas=use_pallas)
+        return jnp.mean(out.astype(jnp.float32) ** 2)
+
+    step = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+    val, grads = step(q, k, v)
+    float(val)
+    start = time.perf_counter()
+    acc = q
+    for _ in range(iters):
+        val, (gq, gk, gv) = step(acc, k, v)
+        acc = gq  # chain: next iter depends on this one's output
+    float(jnp.sum(acc[..., 0]))
+    elapsed = (time.perf_counter() - start) / iters
+    return elapsed
+
+
+def main():
+    for t in (128, 256, 512, 1024, 2048, 4096):
+        for use_pallas in (False, True):
+            ms = bench(t, use_pallas) * 1e3
+            print(json.dumps({"T": t, "pallas": use_pallas,
+                              "ms_per_step": round(ms, 3)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

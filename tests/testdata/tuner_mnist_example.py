@@ -50,7 +50,9 @@ def hypermodel(hp):
 
 def main(argv=None):
     # dispatch_search appends --study-id/--tuner-id (tuner/dispatch.py
-    # worker contract); env vars remain the manual override.
+    # worker contract); env vars remain the manual override.  argv=None
+    # means "no CLI args" so that importing callers (the test suite) never
+    # inherit pytest's own command line; script mode passes sys.argv[1:].
     import argparse
 
     parser = argparse.ArgumentParser()
@@ -58,7 +60,7 @@ def main(argv=None):
                         default=os.environ.get("STUDY_ID", "mnist_hp_study"))
     parser.add_argument("--tuner-id",
                         default=os.environ.get("TUNER_ID", "tuner0"))
-    args = parser.parse_args(argv)
+    args = parser.parse_args([] if argv is None else argv)
 
     max_trials = int(os.environ.get("TUNER_EXAMPLE_MAX_TRIALS", "4"))
     study_dir = os.environ.get("TUNER_EXAMPLE_STUDY_DIR") or tempfile.mkdtemp(
@@ -84,4 +86,6 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    main(sys.argv[1:])

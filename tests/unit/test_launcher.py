@@ -174,7 +174,10 @@ class FakeSession:
 
     def get(self, url, params=None):
         self.calls.append(("GET", url, None, params))
-        return self._next({})
+        # Default to a READY node: deploy_job's READY-await polls with a
+        # REAL time.sleep when called through run(), so a {} default makes
+        # run()-level tests spin the full 40x10s provisioning budget.
+        return self._next({"state": "READY"})
 
     def delete(self, url):
         self.calls.append(("DELETE", url, None, None))
