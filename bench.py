@@ -42,8 +42,8 @@ MEASURE_STEPS = 20
 
 BERT_BATCH = 32
 BERT_SEQ = 128
-BERT_WARMUP = 2
-BERT_MEASURE = 10
+BERT_WARMUP = 3
+BERT_MEASURE = 20
 
 METRIC = f"resnet50_cifar10_b{BATCH_SIZE}_train_steps_per_sec_per_chip"
 
@@ -116,20 +116,14 @@ def _add_flops_context(extras, prefix, flops, steps_per_sec, n_chips=1):
 
 
 def _throughput(step, state, batch, *, warmup, iters):
-    """Chain ``iters`` dependent steps then force a host read of the final
-    loss.  The state dependency makes the device execute every step before
-    the final metric exists; the host read is the only wait this
-    remote-tunnel endpoint cannot satisfy early (block_until_ready has been
-    observed returning before remote execution completes, inflating
-    loop-timed throughput ~50x)."""
-    for _ in range(warmup):
-        state, metrics = step(state, batch)
-    float(metrics["loss"])
-    start = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = step(state, batch)
-    float(metrics["loss"])
-    return iters / (time.perf_counter() - start)
+    """Chain-then-read timing; single source of truth lives in
+    cloud_tpu/utils/benchmarking.py (imported in the child, where
+    cloud_tpu is already on the path)."""
+    from cloud_tpu.utils.benchmarking import chain_then_read_throughput
+
+    return chain_then_read_throughput(
+        step, state, batch, warmup=warmup, iters=iters
+    )
 
 
 def _measure_resnet(extras):
@@ -218,9 +212,12 @@ def _measure_bert(extras):
         compiled, state, batch, warmup=BERT_WARMUP, iters=BERT_MEASURE
     )
     extras["bert_steps_per_sec"] = round(steps_per_sec, 3)
+    # n_chips=1: with mesh=None this step executes on ONE device no matter
+    # how many the endpoint exposes, so whole-batch FLOPs vs one chip's
+    # peak is the correct per-chip MFU.
     _add_flops_context(
         extras, "bert_", _bert_analytic_flops(cfg, BERT_BATCH, BERT_SEQ),
-        steps_per_sec, n_chips=len(jax.devices()),
+        steps_per_sec, n_chips=1,
     )
 
 

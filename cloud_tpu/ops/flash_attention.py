@@ -49,6 +49,18 @@ DEFAULT_BLOCK_K = 512
 #: ``use_pallas=True`` explicitly.
 MIN_SEQ_LEN_FOR_KERNEL = int(os.environ.get("CLOUD_TPU_FLASH_MIN_SEQ", 1024))
 
+#: ...unless the would-be [B, H, Tq, Tk] f32 score tensor is this large
+#: (bytes), in which case the kernel is taken regardless of T.  Speed is
+#: not the issue below the T threshold — memory is: under ``value_and_grad``
+#: XLA saves the softmax scores as residuals PER LAYER (a 12-layer BERT
+#: scan at B=32, T=512 allocates 4.5 GiB f32 + 2.25 GiB bf16 of score
+#: residuals and OOMs a 16 GiB v5e chip), while the kernel's residual is
+#: the O(T) logsumexp.  128 MiB per call keeps a 12-layer stack under
+#: ~1.5 GiB of attention residuals.
+SCORE_BYTES_FOR_KERNEL = int(
+    os.environ.get("CLOUD_TPU_FLASH_SCORE_BYTES", 128 * 1024**2)
+)
+
 
 # ---------------------------------------------------------------------------
 # Reference implementation (ground truth + non-TPU fallback)
@@ -483,6 +495,41 @@ def _kernel_eligible(q, k, block_q, block_k) -> bool:
     )
 
 
+def would_use_kernel(
+    q,
+    k,
+    mask: Optional[jnp.ndarray] = None,
+    *,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> bool:
+    """The full ``use_pallas=None`` auto-dispatch predicate, exposed so
+    callers (e.g. the pp-fallback warning in models/layers.py) never
+    duplicate it and drift."""
+    import jax as _jax
+
+    fitted_q = _fit_block(q.shape[1], block_q)
+    fitted_k = _fit_block(k.shape[1], block_k)
+    mask_ok = mask is None or (
+        mask.ndim == 2
+        and mask.shape[0] == q.shape[0]
+        and mask.shape[1] == k.shape[1]
+    )
+    score_bytes = (
+        q.shape[0] * q.shape[2] * q.shape[1] * k.shape[1] * 4
+        if q.ndim == 4 else 0
+    )
+    return (
+        _jax.default_backend() == "tpu"
+        and mask_ok
+        and (
+            q.shape[1] >= MIN_SEQ_LEN_FOR_KERNEL
+            or score_bytes >= SCORE_BYTES_FOR_KERNEL
+        )
+        and _kernel_eligible(q, k, fitted_q, fitted_k)
+    )
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -513,11 +560,8 @@ def flash_attention(
         and mask.shape[1] == k.shape[1]
     )
     if use_pallas is None:
-        use_pallas = (
-            jax.default_backend() == "tpu"
-            and mask_ok
-            and q.shape[1] >= MIN_SEQ_LEN_FOR_KERNEL
-            and _kernel_eligible(q, k, fitted_q, fitted_k)
+        use_pallas = would_use_kernel(
+            q, k, mask, block_q=block_q, block_k=block_k
         )
     if interpret:
         use_pallas = True

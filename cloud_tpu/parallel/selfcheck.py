@@ -15,7 +15,17 @@ processes over real collectives, not an env-var simulation.
 
 Env knobs: ``CLOUD_TPU_SELFCHECK_FORCE_CPU=1`` pins the CPU platform
 (the local rig), ``CLOUD_TPU_SELFCHECK_TIMEOUT`` bounds the distributed
-init (default 60 s).
+init (default 60 s), and ``CLOUD_TPU_SELFCHECK_MODE`` picks the check:
+
+- ``basic`` (default): dp-only mesh, cross-process psum + dense-MNIST step.
+- ``transformer``: an fsdp x tp mesh whose fsdp axis CROSSES process
+  boundaries, one CloudLM train step — the model-parallel layout SURVEY §7
+  warns hangs (not errors) when mis-wired.
+- ``pp``: a pp x tp mesh whose pp axis spans processes, so the pipeline's
+  ppermute shift register rides cross-process links.
+- ``records``: every process streams its shard of a shared record dir
+  (``CLOUD_TPU_SELFCHECK_RECORDS_DIR``) and reports the example ids it saw
+  (the caller asserts the shards are disjoint and complete).
 """
 
 from __future__ import annotations
@@ -23,6 +33,97 @@ from __future__ import annotations
 import json
 import os
 import sys
+
+
+def _check_transformer(report, mesh_sizes, *, pipeline: bool) -> None:
+    """One CloudLM train step on a model-parallel mesh; loss into report."""
+    import functools
+
+    import jax
+    import numpy as np
+    import optax
+
+    from cloud_tpu import parallel
+    from cloud_tpu.models import transformer
+    from cloud_tpu.training import train as train_lib
+
+    rules = (
+        parallel.DEFAULT_RULES.extended(layers="pp")
+        if pipeline
+        else parallel.DEFAULT_RULES
+    )
+    cfg = transformer.TINY
+    mesh = parallel.MeshSpec(mesh_sizes).build()
+    report["mesh"] = {k: v for k, v in mesh.shape.items() if v > 1}
+    logical_axes = transformer.param_logical_axes(cfg)
+
+    # Batch rows shard over the "batch" logical axes (dp x fsdp).  Each
+    # process feeds only its own rows; ranks on a batch-replicated layout
+    # (the pp mesh) all feed the same global batch.
+    batch_axes = set(
+        a for a in (rules.rules.get("batch") or ()) if a
+    )
+    shard_procs = 1
+    for axis in batch_axes:
+        shard_procs *= mesh_sizes.get(axis, 1)
+    shard_procs = min(shard_procs, jax.process_count())
+    global_batch, t = 8, 32
+    local_rows = global_batch // shard_procs
+    seed = jax.process_index() if shard_procs > 1 else 0
+    rng = np.random.default_rng(seed)
+    local_batch = {
+        "tokens": rng.integers(
+            0, cfg.vocab_size, (local_rows, t)
+        ).astype(np.int32)
+    }
+
+    with parallel.use_mesh(mesh):
+        state = train_lib.create_sharded_state(
+            jax.random.PRNGKey(0),
+            functools.partial(transformer.init, config=cfg),
+            optax.sgd(0.1),
+            mesh,
+            logical_axes=logical_axes,
+            rules=rules,
+        )
+        step = train_lib.make_train_step(
+            functools.partial(transformer.loss_fn, config=cfg, rules=rules,
+                              mesh=mesh),
+            optax.sgd(0.1),
+            logical_axes=logical_axes,
+            rules=rules,
+            mesh=mesh,
+        )
+        batch = train_lib.shard_batch(local_batch, mesh, rules)
+        state, metrics = step(state, batch)
+        state, metrics = step(state, batch)  # step 2 proves params updated
+        report["loss"] = float(metrics["loss"])
+
+    import numpy as _np
+
+    report["ok"] = bool(_np.isfinite(report["loss"]))
+
+
+def _check_records(report) -> None:
+    """Stream this process's shard of a shared record dir; report ids."""
+    import jax
+
+    from cloud_tpu.training import records
+
+    data_dir = os.environ["CLOUD_TPU_SELFCHECK_RECORDS_DIR"]
+    ds = records.RecordDataset(
+        os.path.join(data_dir, "*.rec"), batch_size=2,
+        drop_remainder=False,
+    )
+    seen = []
+    for batch in ds():
+        seen.extend(int(x) for x in batch["x"][:, 0])
+    report.update(
+        shard_files=[os.path.basename(p) for p in ds.shard_files],
+        example_ids=sorted(seen),
+        loss=0.0,
+        ok=True,
+    )
 
 
 def run_selfcheck() -> dict:
@@ -45,6 +146,28 @@ def run_selfcheck() -> dict:
         local_device_count=jax.local_device_count(),
         platform=jax.devices()[0].platform,
     )
+
+    mode = os.environ.get("CLOUD_TPU_SELFCHECK_MODE", "basic")
+    if mode == "transformer":
+        report["phase"] = "transformer_step"
+        _check_transformer(
+            report, {"fsdp": jax.device_count() // 2, "tp": 2},
+            pipeline=False,
+        )
+        report["phase"] = "done"
+        return report
+    if mode == "pp":
+        report["phase"] = "pp_step"
+        _check_transformer(
+            report, {"pp": jax.device_count() // 2, "tp": 2}, pipeline=True
+        )
+        report["phase"] = "done"
+        return report
+    if mode == "records":
+        report["phase"] = "records"
+        _check_records(report)
+        report["phase"] = "done"
+        return report
 
     import functools
 

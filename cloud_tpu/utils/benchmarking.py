@@ -1,0 +1,33 @@
+"""The load-bearing device-timing contract, in ONE place.
+
+On remote-tunnel TPU endpoints ``jax.block_until_ready`` has been observed
+returning before remote execution completes (inflating loop-timed
+throughput ~50x), and the first call after warmup can recompile (committed
+vs uncommitted input shardings).  Both ``bench.py`` and
+``scripts/measure_baselines.py`` time through this helper so a future
+timing-trap fix lands once.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def chain_then_read_throughput(step, state, batch, *, warmup=3, iters=20):
+    """Steps/sec of ``step(state, batch) -> (state, metrics)``.
+
+    Chains ``iters`` dependent steps (each consumes the prior state, so the
+    device must execute all of them in order) then forces a host read of
+    the final loss — the only wait a remote tunnel cannot satisfy early.
+    ``warmup`` must chain >= 3 steps so the committed-sharding recompile is
+    absorbed before timing (BASELINE.md "Timing methodology").
+    """
+    metrics = None
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    float(next(iter(metrics.values())))
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    float(next(iter(metrics.values())))
+    return iters / (time.perf_counter() - start)
