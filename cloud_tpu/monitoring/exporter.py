@@ -1,11 +1,19 @@
-"""Cloud Monitoring transport + the exporter wiring.
+"""Cloud Monitoring exporter wiring + the Python FALLBACK transport.
 
-Reference analogue: ``stackdriver_client.cc`` — snapshot types -> Cloud
-Monitoring v3 structures (histogram->Distribution :69-98, point by type
+The primary wire client is native C++ (``cpp/wire_client.cc``, the
+equivalent of the reference's ``stackdriver_client.cc``): when the shared
+library is built and libcurl resolves, the whole periodic path — timer
+thread, snapshot, snapshot->TimeSeries conversion, HTTP POST, OAuth token
+from the TPU-VM metadata server — runs in C++ with no Python hop
+(SURVEY.md §2.5: "C++ TPU-native equivalents, not Python stand-ins").
+
+This module keeps (a) the start/stop lifecycle and env gates, and (b) a
+pure-Python ``CloudMonitoringExporter`` used only when the native path is
+unavailable (no .so / no libcurl / an injected test session forces the
+Python transport).  Reference mapping for the fallback:
+``stackdriver_client.cc`` histogram->Distribution :69-98, point by type
 :100-124, ``custom.googleapis.com`` metric prefix :126-136, descriptor
-creation deduped per name :138-183/:105-126), project from env (:38-43).
-The gRPC stub becomes the injectable REST session; the periodic thread
-stays native (``cpp/exporter.cc``) and calls back into ``_sink``.
+dedup :105-126, project from env :38-43.
 """
 
 from __future__ import annotations
@@ -181,6 +189,47 @@ def start_exporter(project: Optional[str] = None, session=None) -> bool:
         # constructing a second exporter, which would rebind the sink and
         # final flush onto a fresh descriptor-dedup set mid-run.
         return True
+
+    # Preferred: the all-native wire path (no Python in the loop).  An
+    # injected session is a test/transport override and forces the Python
+    # exporter; CLOUD_TPU_MONITORING_WIRE=python opts out explicitly.
+    if (
+        metrics_lib.backend() == "native"
+        and session is None
+        and os.environ.get("CLOUD_TPU_MONITORING_WIRE", "native") != "python"
+    ):
+        lib = metrics_lib._get_registry()._lib  # type: ignore[union-attr]
+        if (
+            hasattr(lib, "ctpu_wire_available")
+            and lib.ctpu_wire_available()
+            and (project or os.environ.get(ENV_PROJECT))
+        ):
+            lib.ctpu_wire_set_project.argtypes = [ctypes.c_char_p]
+            lib.ctpu_wire_export_snapshot.argtypes = [ctypes.c_char_p]
+            if project:
+                lib.ctpu_wire_set_project(project.encode())
+            lib.ctpu_exporter_use_wire_client()
+            lib.ctpu_exporter_config_reload()
+            _started = bool(lib.ctpu_exporter_start())
+            if _started:
+                def native_flush() -> None:
+                    rc = lib.ctpu_wire_export_snapshot(
+                        json.dumps(
+                            _filtered_snapshot(_env_allowlist())
+                        ).encode()
+                    )
+                    if rc != 0:
+                        logger.warning(
+                            "native final metrics flush failed (status %d)",
+                            rc,
+                        )
+
+                _final_flush = native_flush
+                logger.info("monitoring: native C++ wire client active")
+            else:
+                _final_flush = None
+            return _started
+
     exporter = CloudMonitoringExporter(project=project, session=session)
 
     def sink_json(payload: str) -> None:
