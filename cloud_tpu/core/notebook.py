@@ -33,6 +33,58 @@ def called_from_notebook() -> bool:
         return False
 
 
+def fetch_live_notebook_script(
+    output_dir: str | None = None,
+    *,
+    timeout_sec: int = 200,
+    _request=None,
+) -> str:
+    """Fetch the RUNNING Colab notebook over the kernel RPC and write it
+    out as a runnable .py; returns the script path.
+
+    Reference analogue: ``preprocess.py:196-212`` — a blocking
+    ``get_ipynb`` request to the Colab frontend (the notebook need not
+    exist on disk; Colab keeps it in the browser session), code cells
+    concatenated, shell/magic/comment lines stripped.  ``_request`` is the
+    test seam for the RPC (the reference's tests mocked the same call).
+    """
+    request = _request
+    if request is None:
+        try:
+            from google.colab import _message
+        except ImportError as exc:
+            raise RuntimeError(
+                "Live-notebook fetch needs the Colab runtime "
+                "(google.colab is not importable)."
+            ) from exc
+
+        def request(method, request_body):
+            return _message.blocking_request(
+                method, request=request_body, timeout_sec=timeout_sec
+            )
+
+    response = request("get_ipynb", "")
+    if response is None:
+        # Same failure contract as the reference (preprocess.py:199-201).
+        raise RuntimeError("Unable to get the notebook contents.")
+    lines: list[str] = []
+    for cell in response["ipynb"]["cells"]:
+        if cell.get("cell_type") != "code":
+            continue
+        source = cell.get("source", [])
+        if isinstance(source, str):
+            source = source.splitlines()
+        for raw in source:
+            line = raw.rstrip("\n")
+            if not _MAGIC_LINE.match(line):
+                lines.append(line)
+    output_dir = output_dir or tempfile.mkdtemp(prefix="cloud_tpu_colab_")
+    script_path = os.path.join(output_dir, "colab_notebook.py")
+    with open(script_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return script_path
+
+
 def notebook_to_script(notebook_path: str, output_dir: str | None = None) -> str:
     """Convert an .ipynb to a runnable .py, stripping shell/magic/comment
     lines (reference preprocess.py:181-187), and return the script path."""

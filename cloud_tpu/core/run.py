@@ -125,9 +125,20 @@ def run(
     resolved_entry = entry_point
     temp_dirs = []
     if called_from_notebook and entry_point is None:
-        raise ValueError(
-            "In a notebook, pass entry_point= (the .ipynb or .py to run)."
-        )
+        # Colab: the live notebook is fetched over the kernel RPC — it
+        # need not exist on disk (reference preprocess.py:196-212).
+        try:
+            resolved_entry = notebook.fetch_live_notebook_script()
+        except (RuntimeError, KeyError, TypeError) as exc:
+            # RuntimeError: not a Colab runtime / frontend returned None;
+            # KeyError/TypeError: malformed RPC response shape.  All get
+            # the same actionable guidance instead of a raw traceback.
+            raise ValueError(
+                "In this notebook environment the live-notebook fetch is "
+                f"unavailable ({exc!r}); pass entry_point= (the .ipynb or "
+                ".py to run)."
+            ) from exc
+        temp_dirs.append(os.path.dirname(resolved_entry))
     if resolved_entry is not None and resolved_entry.endswith(".ipynb"):
         resolved_entry = notebook.notebook_to_script(resolved_entry)
         temp_dirs.append(os.path.dirname(resolved_entry))

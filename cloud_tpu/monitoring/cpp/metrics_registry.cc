@@ -19,8 +19,19 @@ int BucketIndex(double value) {
   return idx;
 }
 
-// Minimal JSON string escaping (metric names are identifiers, but be safe).
-std::string Escape(const std::string& s) {
+void AppendDouble(std::ostringstream& os, double v) {
+  if (std::isfinite(v)) {
+    os << v;
+  } else {
+    os << "0";  // JSON has no inf/nan; clamp
+  }
+}
+
+}  // namespace
+
+// Shared with the wire client (metrics_registry.h): names are escaped the
+// same way INTO snapshots and OUT into request bodies.
+std::string JsonEscapeString(const std::string& s) {
   std::string out;
   out.reserve(s.size());
   for (char c : s) {
@@ -41,16 +52,6 @@ std::string Escape(const std::string& s) {
   }
   return out;
 }
-
-void AppendDouble(std::ostringstream& os, double v) {
-  if (std::isfinite(v)) {
-    os << v;
-  } else {
-    os << "0";  // JSON has no inf/nan; clamp
-  }
-}
-
-}  // namespace
 
 void Distribution::Record(double value) {
   ++count;
@@ -100,7 +101,7 @@ std::string MetricsRegistry::SnapshotJsonFiltered(
     if (!filter(name, arg)) continue;
     if (!first) os << ",";
     first = false;
-    os << "\"" << Escape(name) << "\":" << value;
+    os << "\"" << JsonEscapeString(name) << "\":" << value;
   }
   os << "},\"gauges\":{";
   first = true;
@@ -108,7 +109,7 @@ std::string MetricsRegistry::SnapshotJsonFiltered(
     if (!filter(name, arg)) continue;
     if (!first) os << ",";
     first = false;
-    os << "\"" << Escape(name) << "\":";
+    os << "\"" << JsonEscapeString(name) << "\":";
     AppendDouble(os, value);
   }
   os << "},\"distributions\":{";
@@ -117,7 +118,7 @@ std::string MetricsRegistry::SnapshotJsonFiltered(
     if (!filter(name, arg)) continue;
     if (!first) os << ",";
     first = false;
-    os << "\"" << Escape(name) << "\":{\"count\":" << dist.count
+    os << "\"" << JsonEscapeString(name) << "\":{\"count\":" << dist.count
        << ",\"mean\":";
     AppendDouble(os, dist.mean);
     os << ",\"sum_squared_deviation\":";

@@ -20,6 +20,11 @@
 
 namespace cloud_tpu {
 
+// JSON string escaping shared by the registry (names INTO snapshots) and
+// the wire client (names OUT into request bodies) — one implementation so
+// the two sides can never disagree on an escape.
+std::string JsonEscapeString(const std::string& s);
+
 // Exponential histogram buckets: [0, 1), [1, 2), [2, 4), ... 2^k.
 constexpr int kNumBuckets = 24;
 

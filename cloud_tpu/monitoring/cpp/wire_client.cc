@@ -2,6 +2,7 @@
 
 #include <dlfcn.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -32,8 +33,9 @@ std::string Rfc3339Now() {
 
 // ---------------------------------------------------------------------------
 // Minimal JSON reader for the registry snapshot schema (flat objects of
-// numbers, one nested object per distribution, one numeric array).  Names
-// are metric identifiers; only \" and \\ escapes are handled.
+// numbers, one nested object per distribution, one numeric array).
+// String escapes mirror JsonEscapeString (the registry produces every
+// string this parser reads).
 // ---------------------------------------------------------------------------
 
 struct JsonValue {
@@ -111,8 +113,29 @@ class JsonParser {
     out->kind = JsonValue::kString;
     ++pos_;
     while (pos_ < s_.size() && s_[pos_] != '"') {
-      if (s_[pos_] == '\\' && pos_ + 1 < s_.size()) ++pos_;
-      out->text.push_back(s_[pos_]);
+      char c = s_[pos_];
+      if (c == '\\' && pos_ + 1 < s_.size()) {
+        // Decode the escapes JsonEscapeString emits (the registry is the
+        // producer of every string this parser reads).
+        ++pos_;
+        switch (s_[pos_]) {
+          case 'n': c = '\n'; break;
+          case 't': c = '\t'; break;
+          case 'r': c = '\r'; break;
+          case 'b': c = '\b'; break;
+          case 'f': c = '\f'; break;
+          case 'u': {
+            if (pos_ + 4 < s_.size()) {
+              const std::string hex = s_.substr(pos_ + 1, 4);
+              c = static_cast<char>(std::strtol(hex.c_str(), nullptr, 16));
+              pos_ += 4;
+            }
+            break;
+          }
+          default: c = s_[pos_];  // \" \\ \/ and anything else: literal
+        }
+      }
+      out->text.push_back(c);
       ++pos_;
     }
     if (pos_ >= s_.size()) return false;
@@ -154,35 +177,21 @@ std::string FormatDouble(double value) {
   return std::string(buf);
 }
 
-// JSON string escaping for metric names: the registry escapes names into
-// its snapshot, JsonParser un-escapes on read, so they must be re-escaped
-// on the way out or a quote in a name yields an invalid request body.
-std::string EscapeJson(const std::string& value) {
-  std::string out;
-  out.reserve(value.size());
-  for (const char c : value) {
-    if (c == '"' || c == '\\') out.push_back('\\');
-    if (static_cast<unsigned char>(c) < 0x20) {
-      char buf[8];
-      std::snprintf(buf, sizeof(buf), "\\u%04x", c);
-      out += buf;
-      continue;
-    }
-    out.push_back(c);
-  }
-  return out;
-}
+// Names are re-escaped on the way out with the SAME escaper the registry
+// used on the way in (JsonEscapeString, metrics_registry.h) — a quote or
+// control char in a metric name round-trips instead of corrupting the
+// request body.
 
 constexpr char kMetricPrefix[] = "custom.googleapis.com/cloud_tpu";
 constexpr double kBucketGrowth = 2.0;  // registry buckets are 2^(k-1)
 
-void AppendSeries(std::ostringstream& out, bool* first, const std::string& name,
-                  const char* kind, const std::string& value_json,
-                  const std::string& start_time, const std::string& end_time) {
-  if (!*first) out << ",";
-  *first = false;
+std::string OneSeries(const std::string& name, const char* kind,
+                      const std::string& value_json,
+                      const std::string& start_time,
+                      const std::string& end_time) {
+  std::ostringstream out;
   out << "{\"metric\":{\"type\":\"" << kMetricPrefix << "/"
-      << EscapeJson(name) << "\"},"
+      << JsonEscapeString(name) << "\"},"
       << "\"resource\":{\"type\":\"global\",\"labels\":{}},"
       << "\"metricKind\":\"" << kind << "\",\"points\":[{\"interval\":{";
   if (std::string(kind) == "CUMULATIVE") {
@@ -190,6 +199,78 @@ void AppendSeries(std::ostringstream& out, bool* first, const std::string& name,
   }
   out << "\"endTime\":\"" << end_time << "\"},\"value\":" << value_json
       << "}]}";
+  return out.str();
+}
+
+// The API caps CreateTimeSeries at 200 series per call (the Python
+// fallback chunks the same way).
+constexpr size_t kMaxSeriesPerPost = 200;
+
+std::string JoinSeriesChunk(const std::vector<std::string>& series,
+                            size_t begin, size_t end) {
+  std::ostringstream out;
+  out << "{\"timeSeries\":[";
+  for (size_t i = begin; i < end; ++i) {
+    if (i != begin) out << ",";
+    out << series[i];
+  }
+  out << "]}";
+  return out.str();
+}
+
+std::vector<std::string> SeriesList(const std::string& snapshot_json,
+                                    const std::string& start_time,
+                                    const std::string& end_time) {
+  std::vector<std::string> series;
+  JsonValue snapshot;
+  if (!JsonParser(snapshot_json).Parse(&snapshot)) return series;
+  if (const JsonValue* counters = Find(snapshot, "counters")) {
+    for (const auto& entry : counters->members) {
+      series.push_back(OneSeries(
+          entry.first, "CUMULATIVE",
+          "{\"int64Value\":\"" +
+              std::to_string(static_cast<long long>(entry.second.number)) +
+              "\"}",
+          start_time, end_time));
+    }
+  }
+  if (const JsonValue* gauges = Find(snapshot, "gauges")) {
+    for (const auto& entry : gauges->members) {
+      series.push_back(OneSeries(
+          entry.first, "GAUGE",
+          "{\"doubleValue\":" + FormatDouble(entry.second.number) + "}",
+          start_time, end_time));
+    }
+  }
+  if (const JsonValue* dists = Find(snapshot, "distributions")) {
+    for (const auto& entry : dists->members) {
+      const JsonValue& dist = entry.second;
+      const JsonValue* buckets = Find(dist, "buckets");
+      const JsonValue* count = Find(dist, "count");
+      const JsonValue* mean = Find(dist, "mean");
+      const JsonValue* ssd = Find(dist, "sum_squared_deviation");
+      if (!buckets || !count || !mean || !ssd) continue;
+      std::ostringstream value;
+      value << "{\"distributionValue\":{\"count\":\""
+            << static_cast<long long>(count->number)
+            << "\",\"mean\":" << FormatDouble(mean->number)
+            << ",\"sumOfSquaredDeviation\":" << FormatDouble(ssd->number)
+            << ",\"bucketOptions\":{\"exponentialBuckets\":{"
+            << "\"numFiniteBuckets\":"
+            << static_cast<int>(buckets->items.size()) - 2
+            << ",\"growthFactor\":" << FormatDouble(kBucketGrowth)
+            << ",\"scale\":1}},\"bucketCounts\":[";
+      for (size_t i = 0; i < buckets->items.size(); ++i) {
+        if (i != 0) value << ",";
+        value << "\"" << static_cast<long long>(buckets->items[i].number)
+              << "\"";
+      }
+      value << "]}}";
+      series.push_back(OneSeries(entry.first, "CUMULATIVE", value.str(),
+                                 start_time, end_time));
+    }
+  }
+  return series;
 }
 
 // ---------------------------------------------------------------------------
@@ -214,6 +295,7 @@ struct CurlApi {
   int (*easy_getinfo)(void*, int, ...) = nullptr;
   void* (*slist_append)(void*, const char*) = nullptr;
   void (*slist_free_all)(void*) = nullptr;
+  int (*global_init)(long) = nullptr;
   bool ok = false;
 };
 
@@ -240,9 +322,17 @@ CurlApi& Curl() {
         dlsym(lib, "curl_slist_append"));
     a->slist_free_all =
         reinterpret_cast<void (*)(void*)>(dlsym(lib, "curl_slist_free_all"));
+    a->global_init =
+        reinterpret_cast<int (*)(long)>(dlsym(lib, "curl_global_init"));
     a->ok = a->easy_init && a->easy_setopt && a->easy_perform &&
             a->easy_cleanup && a->easy_getinfo && a->slist_append &&
             a->slist_free_all;
+    // Explicit one-time global init inside this static initializer (so it
+    // runs exactly once, before any thread uses easy handles): relying on
+    // easy_init's lazy implicit init is not thread-safe on older libcurl.
+    if (a->ok && a->global_init != nullptr) {
+      a->global_init(3L /* CURL_GLOBAL_ALL */);
+    }
     return a;
   }();
   return *api;
@@ -320,59 +410,10 @@ WireClient& WireClient::Global() {
 std::string WireClient::TimeSeriesBody(const std::string& snapshot_json,
                                        const std::string& start_time,
                                        const std::string& end_time) {
-  JsonValue snapshot;
-  if (!JsonParser(snapshot_json).Parse(&snapshot)) return "";
-  std::ostringstream out;
-  bool first = true;
-  out << "{\"timeSeries\":[";
-  if (const JsonValue* counters = Find(snapshot, "counters")) {
-    for (const auto& entry : counters->members) {
-      AppendSeries(out, &first, entry.first, "CUMULATIVE",
-                   "{\"int64Value\":\"" +
-                       std::to_string(static_cast<long long>(
-                           entry.second.number)) +
-                       "\"}",
-                   start_time, end_time);
-    }
-  }
-  if (const JsonValue* gauges = Find(snapshot, "gauges")) {
-    for (const auto& entry : gauges->members) {
-      AppendSeries(out, &first, entry.first, "GAUGE",
-                   "{\"doubleValue\":" + FormatDouble(entry.second.number) +
-                       "}",
-                   start_time, end_time);
-    }
-  }
-  if (const JsonValue* dists = Find(snapshot, "distributions")) {
-    for (const auto& entry : dists->members) {
-      const JsonValue& dist = entry.second;
-      const JsonValue* buckets = Find(dist, "buckets");
-      const JsonValue* count = Find(dist, "count");
-      const JsonValue* mean = Find(dist, "mean");
-      const JsonValue* ssd = Find(dist, "sum_squared_deviation");
-      if (!buckets || !count || !mean || !ssd) continue;
-      std::ostringstream value;
-      value << "{\"distributionValue\":{\"count\":\""
-            << static_cast<long long>(count->number)
-            << "\",\"mean\":" << FormatDouble(mean->number)
-            << ",\"sumOfSquaredDeviation\":" << FormatDouble(ssd->number)
-            << ",\"bucketOptions\":{\"exponentialBuckets\":{"
-            << "\"numFiniteBuckets\":"
-            << static_cast<int>(buckets->items.size()) - 2
-            << ",\"growthFactor\":" << FormatDouble(kBucketGrowth)
-            << ",\"scale\":1}},\"bucketCounts\":[";
-      for (size_t i = 0; i < buckets->items.size(); ++i) {
-        if (i != 0) value << ",";
-        value << "\"" << static_cast<long long>(buckets->items[i].number)
-              << "\"";
-      }
-      value << "]}}";
-      AppendSeries(out, &first, entry.first, "CUMULATIVE", value.str(),
-                   start_time, end_time);
-    }
-  }
-  out << "]}";
-  return first ? "" : out.str();
+  const std::vector<std::string> series =
+      SeriesList(snapshot_json, start_time, end_time);
+  if (series.empty()) return "";
+  return JoinSeriesChunk(series, 0, series.size());
 }
 
 std::vector<std::pair<std::string, std::string>>
@@ -398,10 +439,10 @@ WireClient::PendingDescriptors(const std::string& snapshot_json) {
       if (described_.count(entry.first) != 0) continue;
       std::ostringstream body;
       body << "{\"type\":\"" << kMetricPrefix << "/"
-           << EscapeJson(entry.first) << "\",\"metricKind\":\"" << group.kind
+           << JsonEscapeString(entry.first) << "\",\"metricKind\":\"" << group.kind
            << "\",\"valueType\":\"" << group.value_type
            << "\",\"description\":\"cloud_tpu framework metric "
-           << EscapeJson(entry.first) << "\"}";
+           << JsonEscapeString(entry.first) << "\"}";
       out.emplace_back(entry.first, body.str());
     }
   }
@@ -450,15 +491,19 @@ int WireClient::ExportSnapshot(const std::string& snapshot_json) {
     }
   }
 
-  const std::string body =
-      TimeSeriesBody(snapshot_json, ProcessStartTime(), Rfc3339Now());
-  if (body.empty()) return 0;
+  const std::vector<std::string> series =
+      SeriesList(snapshot_json, ProcessStartTime(), Rfc3339Now());
+  if (series.empty()) return 0;
   const std::string url = std::string(kMonitoringApi) + "/projects/" +
                           project + "/timeSeries";
-  // The API caps 200 series per call; the registry holds framework metrics
-  // only (far below the cap), so one POST suffices here.
-  const int status = transport(url.c_str(), body.c_str(), auth.c_str());
-  const int rc = (status >= 200 && status < 300) ? 0 : status;
+  int rc = 0;
+  for (size_t begin = 0; begin < series.size(); begin += kMaxSeriesPerPost) {
+    const size_t end =
+        std::min(series.size(), begin + kMaxSeriesPerPost);
+    const std::string body = JoinSeriesChunk(series, begin, end);
+    const int status = transport(url.c_str(), body.c_str(), auth.c_str());
+    if (!(status >= 200 && status < 300) && rc == 0) rc = status;
+  }
   // Failure visibility without log spam: one stderr line per status
   // change (the Python fallback logs every failure via logging).
   {

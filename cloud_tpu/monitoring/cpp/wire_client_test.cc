@@ -126,6 +126,37 @@ void TestDoubleRoundTrip() {
   assert(s.find("\"doubleValue\":1234567") != std::string::npos);
 }
 
+void TestSeriesChunkedAt200() {
+  ctpu_wire_reset();
+  g_requests.clear();
+  ctpu_wire_set_project("test-proj");
+  ctpu_wire_set_transport(CaptureTransport);
+  std::string snapshot = "{\"counters\":{";
+  for (int i = 0; i < 250; ++i) {
+    if (i != 0) snapshot += ",";
+    snapshot += "\"m" + std::to_string(i) + "\":1";
+  }
+  snapshot += "}}";
+  assert(ctpu_wire_export_snapshot(snapshot.c_str()) == 0);
+  int series_posts = 0;
+  for (const Request& request : g_requests) {
+    if (request.url.find("/timeSeries") != std::string::npos) ++series_posts;
+  }
+  assert(series_posts == 2);  // 200 + 50 (API cap per CreateTimeSeries)
+}
+
+void TestEscapedNameRoundTrip() {
+  ctpu_wire_reset();
+  // A name with a tab: the registry writes \t into the snapshot; the wire
+  // client must parse it back and re-emit the SAME escape (shared
+  // JsonEscapeString), not a corrupted literal.
+  char* body = ctpu_wire_time_series_body(
+      "{\"counters\":{\"a\\tb\":1}}", "s", "e");
+  std::string s(body);
+  ctpu_free(body);
+  assert(s.find("cloud_tpu/a\\tb") != std::string::npos);
+}
+
 void TestExportThroughStubTransport() {
   ctpu_wire_reset();
   g_requests.clear();
@@ -188,6 +219,8 @@ int main() {
   TestDescriptorBodiesArePureAndComplete();
   TestDescriptorRetryAfterTransportFailure();
   TestMetricNameEscaping();
+  TestEscapedNameRoundTrip();
+  TestSeriesChunkedAt200();
   TestDoubleRoundTrip();
   TestExportThroughStubTransport();
   TestMissingProjectFails();
