@@ -68,6 +68,12 @@ SCORE_BYTES_FOR_KERNEL = int(
 
 
 def _reference(q, k, v, *, causal, mask):
+    return _reference_with_lse(q, k, v, causal=causal, mask=mask)[0]
+
+
+def _reference_with_lse(q, k, v, *, causal, mask):
+    """Reference path that also returns the log-sum-exp [B, H, T_q] —
+    the quantity ring attention needs to merge per-block partials."""
     dim = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     s = s / math.sqrt(dim)
@@ -77,8 +83,14 @@ def _reference(q, k, v, *, causal, mask):
         s = jnp.where(causal_mask, s, NEG_INF)
     if mask is not None:
         s = jnp.where(mask[:, None, None, :], s, NEG_INF)
-    w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    w = (p / safe_l).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    lse = (m + jnp.log(safe_l))[..., 0]  # [B, H, T_q]
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -239,14 +251,16 @@ def _compiler_params():
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, use_mask):
-    if use_mask:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-         dq_ref, dq_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dq_scr) = refs
-        mask_ref = None
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, use_mask,
+                   use_glse):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    pos = 6
+    glse_ref = refs[pos] if use_glse else None
+    pos += 1 if use_glse else 0
+    mask_ref = refs[pos] if use_mask else None
+    pos += 1 if use_mask else 0
+    dq_ref, dq_scr = refs[pos], refs[pos + 1]
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -282,7 +296,9 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, use_mask):
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
-        ds = p * (dp - delta)  # [block_q, block_k] f32
+        # lse cotangent: d(lse_i)/d(s_ij) = p_ij, so ds += p * g_lse.
+        row_term = delta - (glse_ref[0, 0] if glse_ref is not None else 0.0)
+        ds = p * (dp - row_term)  # [block_q, block_k] f32
         dq_scr[...] += scale * jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -293,14 +309,16 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, use_mask):
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, use_mask):
-    if use_mask:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-        mask_ref = None
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, use_mask,
+                    use_glse):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    pos = 6
+    glse_ref = refs[pos] if use_glse else None
+    pos += 1 if use_glse else 0
+    mask_ref = refs[pos] if use_mask else None
+    pos += 1 if use_mask else 0
+    dk_ref, dv_ref, dk_scr, dv_scr = refs[pos:pos + 4]
     ki, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -344,7 +362,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, use_mask):
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
-        ds = p * (dp - delta)
+        row_term = delta - (glse_ref[0, 0] if glse_ref is not None else 0.0)
+        ds = p * (dp - row_term)
         dk_scr[...] += scale * jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -357,12 +376,16 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, use_mask):
 
 
 def _bwd_pallas(q, k, v, mask, do, out, lse, *, causal, block_q, block_k,
-                interpret):
+                interpret, g_lse=None):
+    """``g_lse`` is the [B, H, T, 1] cotangent of the forward's lse output
+    (None for the out-only entry point); it adds ``p * g_lse`` to ds in
+    both kernels."""
     b, h, t, d = q.shape
     _check_divisible(t, block_q, block_k)
     nq, nk = t // block_q, t // block_k
     scale = 1.0 / math.sqrt(d)
     use_mask = mask is not None
+    use_glse = g_lse is not None
     # delta_i = rowsum(dO_i * O_i): elementwise, XLA fuses it; no kernel.
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
@@ -377,6 +400,9 @@ def _bwd_pallas(q, k, v, mask, do, out, lse, *, causal, block_q, block_k,
 
     dq_in_specs = [qspec, kspec_i, kspec_i, qspec, rowspec, rowspec]
     dq_operands = [q, k, v, do, lse, delta]
+    if use_glse:
+        dq_in_specs.append(rowspec)
+        dq_operands.append(g_lse)
     if use_mask:
         dq_in_specs.append(
             pl.BlockSpec((1, block_k), lambda b_, h_, i, j: (b_, j))
@@ -386,6 +412,7 @@ def _bwd_pallas(q, k, v, mask, do, out, lse, *, causal, block_q, block_k,
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, use_mask=use_mask,
+            use_glse=use_glse,
         ),
         grid=(b, h, nq, nk),
         in_specs=dq_in_specs,
@@ -405,6 +432,9 @@ def _bwd_pallas(q, k, v, mask, do, out, lse, *, causal, block_q, block_k,
     )
     dkv_in_specs = [qspec_j, kspec_o, kspec_o, qspec_j, rowspec_j, rowspec_j]
     dkv_operands = [q, k, v, do, lse, delta]
+    if use_glse:
+        dkv_in_specs.append(rowspec_j)
+        dkv_operands.append(g_lse)
     if use_mask:
         dkv_in_specs.append(
             pl.BlockSpec((1, block_k), lambda b_, h_, i, j: (b_, i))
@@ -414,6 +444,7 @@ def _bwd_pallas(q, k, v, mask, do, out, lse, *, causal, block_q, block_k,
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, use_mask=use_mask,
+            use_glse=use_glse,
         ),
         grid=(b, h, nk, nq),
         in_specs=dkv_in_specs,
@@ -469,6 +500,103 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_lse(q, k, v, mask, causal, block_q, block_k, interpret):
+    """Kernel forward returning (out, lse [B,H,T,1]) — the building block
+    for ring attention's per-block folds.  The VJP handles BOTH outputs'
+    cotangents: g_lse enters ds as ``p * g_lse`` (dlse/ds = softmax)."""
+    return _fwd_pallas(
+        q, k, v, mask, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_lse_fwd(q, k, v, mask, causal, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(
+        q, k, v, mask, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return (out, lse), (q, k, v, mask, out, lse)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, mask, out, lse = residuals
+    g_out, g_lse = g
+    dq, dk, dv = _bwd_pallas(
+        q, k, v, mask, g_out, out, lse, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret, g_lse=g_lse,
+    )
+    dmask = (
+        None if mask is None
+        else np.zeros(mask.shape, jax.dtypes.float0)
+    )
+    return dq, dk, dv, dmask
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _dispatch(q, k, v, *, causal, mask, block_q, block_k, use_pallas,
+              interpret, with_lse):
+    """Shared fit/dispatch/transpose wrapper for both public entry points
+    (kept in ONE place so mask/fit rules can't drift between them)."""
+    fitted_q = _fit_block(q.shape[1], block_q)
+    fitted_k = _fit_block(k.shape[1], block_k)
+    mask_ok = mask is None or (
+        mask.ndim == 2
+        and mask.shape[0] == q.shape[0]
+        and mask.shape[1] == k.shape[1]
+    )
+    if use_pallas is None:
+        use_pallas = would_use_kernel(q, k, mask, block_q=block_q,
+                                      block_k=block_k)
+    if interpret:
+        use_pallas = True
+    if not use_pallas or not mask_ok:
+        if with_lse:
+            return _reference_with_lse(q, k, v, causal=causal, mask=mask)
+        return _reference(q, k, v, causal=causal, mask=mask)
+    # Requested blocks are upper bounds: run with the largest aligned
+    # divisor of T at or below them.  No aligned divisor (forced kernel
+    # path only) falls through to the clamp and _check_divisible's error.
+    block_q = fitted_q if fitted_q is not None else min(block_q, q.shape[1])
+    block_k = fitted_k if fitted_k is not None else min(block_k, k.shape[1])
+    # [B, T, H, D] -> [B, H, T, D] for (T, D)-tiled kernels.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    mask_i32 = None if mask is None else mask.astype(jnp.int32)
+    if with_lse:
+        out, lse = _flash_lse(
+            qt, kt, vt, mask_i32, causal, block_q, block_k, interpret
+        )
+        return out.transpose(0, 2, 1, 3), lse[..., 0]
+    out = _flash(qt, kt, vt, mask_i32, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    mask: Optional[jnp.ndarray] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+):
+    """Like :func:`flash_attention` but also returns lse [B, H, T_q] —
+    fully differentiable in both outputs (ring attention merges per-block
+    partials through the lse, so its gradient must flow).
+    """
+    return _dispatch(
+        q, k, v, causal=causal, mask=mask, block_q=block_q, block_k=block_k,
+        use_pallas=use_pallas, interpret=interpret, with_lse=True,
+    )
 
 
 def _fit_block(t: int, block: int) -> Optional[int]:
@@ -552,30 +680,7 @@ def flash_attention(
     reference path.  ``interpret=True`` runs the kernels in the Pallas
     interpreter (CPU tests of kernel logic).
     """
-    fitted_q = _fit_block(q.shape[1], block_q)
-    fitted_k = _fit_block(k.shape[1], block_k)
-    mask_ok = mask is None or (
-        mask.ndim == 2
-        and mask.shape[0] == q.shape[0]
-        and mask.shape[1] == k.shape[1]
+    return _dispatch(
+        q, k, v, causal=causal, mask=mask, block_q=block_q, block_k=block_k,
+        use_pallas=use_pallas, interpret=interpret, with_lse=False,
     )
-    if use_pallas is None:
-        use_pallas = would_use_kernel(
-            q, k, mask, block_q=block_q, block_k=block_k
-        )
-    if interpret:
-        use_pallas = True
-    if not use_pallas or not mask_ok:
-        return _reference(q, k, v, causal=causal, mask=mask)
-    # Requested blocks are upper bounds: run with the largest aligned
-    # divisor of T at or below them.  No aligned divisor (forced kernel
-    # path only) falls through to the clamp and _check_divisible's error.
-    block_q = fitted_q if fitted_q is not None else min(block_q, q.shape[1])
-    block_k = fitted_k if fitted_k is not None else min(block_k, k.shape[1])
-    # [B, T, H, D] -> [B, H, T, D] for (T, D)-tiled kernels.
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
-    mask_i32 = None if mask is None else mask.astype(jnp.int32)
-    out = _flash(qt, kt, vt, mask_i32, causal, block_q, block_k, interpret)
-    return out.transpose(0, 2, 1, 3)

@@ -8,6 +8,13 @@ blockwise log-sum-exp trick from flash attention, distributed.  After
 memory O(T/sp) per device and communication overlapped with the block
 matmuls by XLA's async collective scheduling.
 
+Each per-block fold runs through the Pallas flash kernel when eligible
+(``flash_attention_with_lse`` — out + lse, differentiable in both, so the
+lse-based merge backpropagates exactly), falling back to the jnp reference
+otherwise.  Block-level causality is exact for equal block sizes: blocks
+strictly in the past attend fully, the diagonal block applies the in-block
+causal mask, and future blocks are folded with weight zero.
+
 No reference counterpart exists (SURVEY.md §5: sequence parallelism absent);
 this is the capability the TPU-native build adds for long-context scale.
 
@@ -18,12 +25,13 @@ is independent along them.
 
 from __future__ import annotations
 
-import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from cloud_tpu.ops.flash_attention import flash_attention_with_lse
 
 NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
 
@@ -35,6 +43,8 @@ def ring_attention(
     axis: str,
     *,
     causal: bool = True,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Exact attention over sequence blocks distributed along ``axis``.
 
@@ -44,6 +54,9 @@ def ring_attention(
         ``[i*T_local, (i+1)*T_local)``).
       axis: mesh axis name carrying the sequence shards.
       causal: apply a causal mask in *global* positions.
+      use_pallas: per-block kernel dispatch — None auto-detects (TPU +
+        tileable local block), True forces the kernel, False forces jnp.
+      interpret: run the kernels in the Pallas interpreter (CPU tests).
 
     Returns:
       Local attention output block ``[B, T_local, H, D]`` in q's dtype.
@@ -51,56 +64,62 @@ def ring_attention(
     n = lax.axis_size(axis)
     my_idx = lax.axis_index(axis)
     b, t, h, d = q.shape
-    scale = 1.0 / math.sqrt(d)
 
-    q_pos = my_idx * t + jnp.arange(t)  # global positions of local queries
-
-    def fold_block(carry, _i, k_blk, v_blk, src_idx):
-        m_acc, l_acc, o_acc = carry
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
-        if causal:
-            k_pos = src_idx * t + jnp.arange(t)
-            mask = q_pos[:, None] >= k_pos[None, :]  # [T_q, T_k]
-            s = jnp.where(mask[None, None, :, :], s, NEG_INF)
-        m_blk = jnp.max(s, axis=-1)  # [B, H, T_q]
-        m_new = jnp.maximum(m_acc, m_blk)
-        # renormalize previous accumulator to the new max
-        correction = jnp.exp(m_acc - m_new)
-        p = jnp.exp(s - m_new[..., None])  # [B, H, T_q, T_k]
-        l_new = l_acc * correction + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v_blk)
-        o_new = o_acc * correction.transpose(0, 2, 1)[..., None] + pv.astype(
-            jnp.float32
+    def block_attention(k_blk, v_blk, block_causal: bool):
+        out, lse = flash_attention_with_lse(
+            q, k_blk, v_blk, causal=block_causal,
+            use_pallas=use_pallas, interpret=interpret,
         )
-        return m_new, l_new, o_new
+        return out.astype(jnp.float32), lse  # [B,T,H,D] f32, [B,H,T] f32
+
+    def fold_block(carry, k_blk, v_blk, src_idx):
+        o_acc, lse_acc = carry
+        if causal:
+            # Exact block-level causality (equal block sizes): past blocks
+            # attend fully, the diagonal applies the in-block mask, and
+            # future blocks SKIP the kernel entirely (lax.cond executes one
+            # branch) and merge with weight exp(NEG_INF - lse) = 0.
+            def skip():
+                return (
+                    jnp.zeros((b, t, h, d), jnp.float32),
+                    jnp.full((b, h, t), NEG_INF, jnp.float32),
+                )
+
+            out_blk, lse_blk = lax.cond(
+                src_idx > my_idx,
+                skip,
+                lambda: lax.cond(
+                    src_idx == my_idx,
+                    lambda: block_attention(k_blk, v_blk, True),
+                    lambda: block_attention(k_blk, v_blk, False),
+                ),
+            )
+        else:
+            out_blk, lse_blk = block_attention(k_blk, v_blk, False)
+        lse_new = jnp.logaddexp(lse_acc, lse_blk)  # [B, H, T]
+        w_acc = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
+        w_blk = jnp.exp(lse_blk - lse_new).transpose(0, 2, 1)[..., None]
+        return o_acc * w_acc + out_blk * w_blk, lse_new
 
     def body(i, carry):
-        m_acc, l_acc, o_acc, k_cur, v_cur = carry
+        o_acc, lse_acc, k_cur, v_cur = carry
         # Block currently held originated at rank (my_idx - i) mod n.
         src_idx = jax.lax.rem(my_idx - i + n, n)
-        m_acc, l_acc, o_acc = fold_block(
-            (m_acc, l_acc, o_acc), i, k_cur, v_cur, src_idx
-        )
+        o_acc, lse_acc = fold_block((o_acc, lse_acc), k_cur, v_cur, src_idx)
         k_nxt = _rotate(k_cur, axis, n)
         v_nxt = _rotate(v_cur, axis, n)
-        return m_acc, l_acc, o_acc, k_nxt, v_nxt
+        return o_acc, lse_acc, k_nxt, v_nxt
 
-    m0 = jnp.full((b, h, t), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, t), jnp.float32)
     o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, t), NEG_INF, jnp.float32)
     # Loop runs n-1 hops (each fold + rotate); the final block is folded
     # outside so no dead K/V rotation ships on the last hop (a fori_loop
     # body is compiled once — XLA cannot trim it per-iteration).
-    m, l, o, k_last, v_last = lax.fori_loop(0, n - 1, body, (m0, l0, o0, k, v))
-    m, l, o = fold_block(
-        (m, l, o), n - 1, k_last, v_last, jax.lax.rem(my_idx - (n - 1) + n, n)
+    o, lse, k_last, v_last = lax.fori_loop(0, n - 1, body, (o0, lse0, k, v))
+    o, lse = fold_block(
+        (o, lse), k_last, v_last, jax.lax.rem(my_idx - (n - 1) + n, n)
     )
-
-    # l==0 only for globally-masked rows (cannot happen with causal=True);
-    # guard anyway so padding-only rows return zeros, not NaN.
-    l_t = l.transpose(0, 2, 1)[..., None]  # [B, T, H, 1]
-    out = o / jnp.where(l_t == 0.0, 1.0, l_t)
-    return out.astype(q.dtype)
+    return o.astype(q.dtype)
 
 
 def _rotate(x, axis, n):

@@ -111,6 +111,142 @@ class TestFlashAttentionForward:
             )
 
 
+class TestFlashAttentionWithLse:
+    """The (out, lse) entry point ring attention folds through: both
+    outputs must match the reference AND be differentiable — g_lse flows
+    into the kernels as ds += p * g_lse."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_reference(self, causal):
+        from cloud_tpu.ops.flash_attention import (
+            _reference_with_lse,
+            flash_attention_with_lse,
+        )
+
+        q, k, v = make_qkv()
+        ref_out, ref_lse = _reference_with_lse(q, k, v, causal=causal,
+                                               mask=None)
+        out, lse = flash_attention_with_lse(
+            q, k, v, causal=causal, interpret=True
+        )
+        np.testing.assert_allclose(out, ref_out, atol=2e-5, rtol=1e-4)
+        np.testing.assert_allclose(lse, ref_lse, atol=2e-5, rtol=1e-4)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_through_both_outputs(self, causal):
+        """Loss mixes out and lse (like ring's merge) so the lse cotangent
+        is nonzero — the pure-kernel grads must match the reference."""
+        from cloud_tpu.ops.flash_attention import (
+            _reference_with_lse,
+            flash_attention_with_lse,
+        )
+
+        q, k, v = make_qkv(t=128)
+
+        def loss(attn_fn, q, k, v):
+            out, lse = attn_fn(q, k, v)
+            return (
+                jnp.mean(out.astype(jnp.float32) ** 2)
+                + 0.3 * jnp.mean(jnp.sin(lse))
+            )
+
+        import functools
+
+        ref_fn = functools.partial(
+            _reference_with_lse, causal=causal, mask=None
+        )
+        kernel_fn = functools.partial(
+            flash_attention_with_lse, causal=causal, interpret=True,
+            block_q=64, block_k=64,
+        )
+        ref_val, ref_grads = jax.value_and_grad(
+            functools.partial(loss, ref_fn), argnums=(0, 1, 2)
+        )(q, k, v)
+        val, grads = jax.value_and_grad(
+            functools.partial(loss, kernel_fn), argnums=(0, 1, 2)
+        )(q, k, v)
+        np.testing.assert_allclose(val, ref_val, atol=1e-5, rtol=1e-5)
+        for g, rg in zip(grads, ref_grads):
+            np.testing.assert_allclose(g, rg, atol=5e-5, rtol=1e-3)
+
+
+class TestRingWithKernelBlocks:
+    """Ring attention's per-block kernel path (interpret mode) must agree
+    with its jnp path and with dense single-device attention."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_interpret_kernel_blocks_match_dense(self, causal):
+        import functools
+
+        from jax.sharding import PartitionSpec
+
+        from cloud_tpu import parallel
+        from cloud_tpu.parallel.ring_attention import ring_attention
+
+        b, t, h, d = 2, 256, 2, 32
+        q, k, v = make_qkv(b=b, t=t, h=h, d=d)
+        expected = _reference(q, k, v, causal=causal, mask=None)
+
+        mesh = parallel.MeshSpec({"sp": 4}).build(jax.devices()[:4])
+        spec = PartitionSpec(None, "sp", None, None)
+        ring = jax.jit(
+            jax.shard_map(
+                functools.partial(
+                    ring_attention, axis="sp", causal=causal,
+                    use_pallas=True, interpret=True,
+                ),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+        )
+        np.testing.assert_allclose(
+            np.asarray(ring(q, k, v)), np.asarray(expected), atol=2e-5
+        )
+
+    def test_gradients_flow_through_merge(self):
+        """d(loss)/d(q,k,v) through the kernel-block ring == dense grads
+        (the lse merge must backpropagate exactly)."""
+        import functools
+
+        from jax.sharding import PartitionSpec
+
+        from cloud_tpu import parallel
+        from cloud_tpu.parallel.ring_attention import ring_attention
+
+        b, t, h, d = 1, 128, 2, 16
+        q, k, v = make_qkv(b=b, t=t, h=h, d=d)
+
+        def dense_loss(q, k, v):
+            out = _reference(q, k, v, causal=True, mask=None)
+            return jnp.mean(out.astype(jnp.float32) ** 2)
+
+        mesh = parallel.MeshSpec({"sp": 2}).build(jax.devices()[:2])
+        spec = PartitionSpec(None, "sp", None, None)
+        ring = jax.shard_map(
+            functools.partial(
+                ring_attention, axis="sp", causal=True,
+                use_pallas=True, interpret=True,
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+
+        def ring_loss(q, k, v):
+            out = ring(q, k, v)
+            return jnp.mean(out.astype(jnp.float32) ** 2)
+
+        dense_grads = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        ring_grads = jax.jit(
+            jax.grad(ring_loss, argnums=(0, 1, 2))
+        )(q, k, v)
+        for g, rg in zip(ring_grads, dense_grads):
+            np.testing.assert_allclose(g, rg, atol=5e-5, rtol=1e-3)
+
+
 class TestFlashAttentionBackward:
     def test_grads_match_reference(self):
         q, k, v = make_qkv()

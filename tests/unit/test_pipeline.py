@@ -100,7 +100,9 @@ class TestTransformerPipeline:
         return {"tokens": rng.integers(0, 255, (b, t)).astype(np.int32)}
 
     def test_forward_matches_unpipelined(self):
-        config = transformer.TINY
+        # f32 so the check is TIGHT: in bf16 a 2% tolerance was needed,
+        # which could hide real schedule divergence (VERDICT r2 weak #6).
+        config = transformer.TINY.scaled(dtype=jnp.float32)
         params = transformer.init(jax.random.PRNGKey(0), config)
         batch = self._batch()
 
@@ -116,7 +118,7 @@ class TestTransformerPipeline:
                 )
             )(params, sharded_batch)
         np.testing.assert_allclose(
-            float(loss_ref), float(loss_pp), rtol=2e-2
+            float(loss_ref), float(loss_pp), rtol=1e-5
         )
 
     def test_train_step_runs_and_improves(self):
