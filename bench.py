@@ -222,15 +222,19 @@ def _measure_bert(extras):
 
 
 def _check_flash_attention(extras):
-    """Compile the Pallas flash kernels on the real device (fwd + bwd) and
-    compare against the jnp reference.  True/False on TPU; None elsewhere
-    (CPU interpret-mode coverage lives in tests/unit/test_ops.py)."""
+    """Compile the Pallas flash kernels on the real device (fwd + bwd,
+    including the (out, lse) ring-attention entry point with its lse
+    cotangent) and compare against the jnp reference.  True/False on TPU;
+    None elsewhere (CPU interpret-mode coverage is tests/unit/test_ops.py)."""
     import jax
     import jax.numpy as jnp
 
     # NB: ``from cloud_tpu.ops import flash_attention`` yields the *function*
     # (re-exported in ops/__init__), not the module.
-    from cloud_tpu.ops.flash_attention import flash_attention
+    from cloud_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_attention_with_lse,
+    )
 
     if jax.default_backend() != "tpu":
         extras["flash_attention_ok"] = None
@@ -243,8 +247,17 @@ def _check_flash_attention(extras):
     )
 
     def loss(q, k, v, use_pallas):
+        # Both entry points in one program: the plain kernel plus the
+        # (out, lse) variant with a nonzero lse cotangent (ring's merge).
         out = flash_attention(q, k, v, causal=True, use_pallas=use_pallas)
-        return jnp.mean(out.astype(jnp.float32) ** 2)
+        out2, lse = flash_attention_with_lse(
+            q, k, v, causal=False, use_pallas=use_pallas
+        )
+        return (
+            jnp.mean(out.astype(jnp.float32) ** 2)
+            + jnp.mean(out2.astype(jnp.float32) ** 2)
+            + 0.3 * jnp.mean(jnp.sin(lse))
+        )
 
     grad_fn = jax.value_and_grad(loss, argnums=(0, 1, 2))
     val_kernel, grads_kernel = jax.jit(

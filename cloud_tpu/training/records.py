@@ -439,6 +439,12 @@ class RecordDataset:
     the native tensor codec.  Batches are stacked along a new leading axis.
     The instance is a zero-arg callable yielding a fresh iterator — the
     ``Trainer.fit`` contract.
+
+    ``decode_threads`` runs decode in an ordered thread pool.  Leave at 0
+    (serial) unless your decode RELEASES THE GIL — measured on this repo's
+    pure-Python codecs the pool is ~30% slower (GIL-bound decode gains no
+    parallelism, pays submit overhead).  The win case is C-backed
+    decompression: JPEG/PNG decode, zlib, np-heavy augmentation.
     """
 
     def __init__(
@@ -454,12 +460,14 @@ class RecordDataset:
         process_index: Optional[int] = None,
         process_count: Optional[int] = None,
         verify: bool = False,
+        decode_threads: int = 0,
         storage_client=None,
     ):
         patterns = [files] if isinstance(files, str) else list(files)
         self.files = _list_files(patterns, storage_client)
         self.batch_size = batch_size
         self.decode = decode or decode_tensor_record
+        self.decode_threads = decode_threads
         self.shuffle_buffer = shuffle_buffer
         self.drop_remainder = drop_remainder
         self.verify = verify
@@ -482,7 +490,7 @@ class RecordDataset:
             self.shard_files = list(self.files)
             self._stride_records = True
 
-    def _examples(self) -> Iterator[Dict[str, np.ndarray]]:
+    def _payloads(self) -> Iterator[bytes]:
         files = list(self.shard_files)
         # In record-striding mode the keep predicate depends on the GLOBAL
         # record index, which is only consistent across hosts when every
@@ -502,7 +510,28 @@ class RecordDataset:
                 )
                 idx += 1
                 if keep:
-                    yield self.decode(payload)
+                    yield payload
+
+    def _examples(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self.decode_threads <= 0:
+            for payload in self._payloads():
+                yield self.decode(payload)
+            return
+        # Ordered parallel decode: submit up to threads*4 payloads ahead,
+        # always yield the oldest future — order (and therefore multi-host
+        # determinism) is preserved while decode overlaps file reads.
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        inflight: "collections.deque" = collections.deque()
+        max_inflight = self.decode_threads * 4
+        with ThreadPoolExecutor(max_workers=self.decode_threads) as pool:
+            for payload in self._payloads():
+                inflight.append(pool.submit(self.decode, payload))
+                if len(inflight) >= max_inflight:
+                    yield inflight.popleft().result()
+            while inflight:
+                yield inflight.popleft().result()
 
     def _shuffled(self) -> Iterator[Dict[str, np.ndarray]]:
         if not self.shuffle_buffer:

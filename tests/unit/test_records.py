@@ -127,6 +127,19 @@ class TestRecordDataset:
         assert sorted(np.concatenate(seen).tolist()) == list(range(12))
         assert all(len(s) == 4 for s in seen)
 
+    def test_parallel_decode_preserves_order(self, tmp_path):
+        write_range_files(tmp_path, num_files=2, per_file=16)
+        serial = records.RecordDataset(
+            str(tmp_path / "*.rec"), batch_size=4, shard_by_process=False
+        )
+        parallel = records.RecordDataset(
+            str(tmp_path / "*.rec"), batch_size=4, shard_by_process=False,
+            decode_threads=4,
+        )
+        got_serial = [b["x"][:, 0].tolist() for b in serial()]
+        got_parallel = [b["x"][:, 0].tolist() for b in parallel()]
+        assert got_parallel == got_serial
+
     def test_shuffle_is_seeded_and_complete(self, tmp_path):
         write_range_files(tmp_path, num_files=2, per_file=8)
         def values(seed):
