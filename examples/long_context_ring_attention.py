@@ -1,0 +1,60 @@
+"""Long-context training with ring attention over the ``sp`` mesh axis.
+
+The sequence dimension is sharded across devices: each chip holds T/sp
+tokens, K/V blocks rotate around the ring over ICI, and attention memory
+stays O(T/sp) per chip — the config that OOMs a single chip trains across
+the slice.  On TPU each per-block fold runs the Pallas flash kernel
+(parallel/ring_attention.py).
+
+Run locally on the virtual CPU rig (no TPU needed):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/long_context_ring_attention.py
+
+On a real slice the same code runs under the planner-produced mesh — ask
+for sequence parallelism with ``ParallelismHints(sp=...)`` when launching
+through ``cloud_tpu.run()``.
+"""
+
+import functools
+
+import jax
+import numpy as np
+import optax
+
+
+def main():
+    from cloud_tpu import parallel
+    from cloud_tpu.models import transformer
+    from cloud_tpu.training import Trainer, data
+
+    n = jax.device_count()
+    # fsdp x sp: parameters ZeRO-sharded over fsdp, sequence over sp.
+    mesh = parallel.MeshSpec({"fsdp": max(n // 4, 1), "sp": 4}).build()
+    print(f"mesh: {[f'{a}={s}' for a, s in mesh.shape.items() if s > 1]}")
+
+    config = transformer.TINY  # seq_len scales to millions on real slices
+    seq_len = 128  # divisible by sp=4 -> 32 tokens per device
+
+    trainer = Trainer(
+        functools.partial(transformer.loss_fn, config=config, mesh=mesh),
+        optax.adamw(1e-3),
+        init_fn=functools.partial(transformer.init, config=config),
+        mesh=mesh,
+        logical_axes=transformer.param_logical_axes(config),
+    )
+    trainer.init_state(jax.random.PRNGKey(0))
+
+    dataset = data.synthetic_tokens(
+        vocab_size=config.vocab_size, seq_len=seq_len, batch_size=8,
+        num_batches=4,
+    )
+    history = trainer.fit(dataset, epochs=3)
+    losses = [round(x, 4) for x in history.history["loss"]]
+    print(f"losses per epoch: {losses}")
+    assert losses[-1] < losses[0], "loss should improve"
+    print("ring-attention training ran end to end")
+
+
+if __name__ == "__main__":
+    main()

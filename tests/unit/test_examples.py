@@ -31,6 +31,13 @@ def load_module(path, name):
 
 
 class TestGoldenWorkloads:
+    def test_long_context_ring_example_trains(self):
+        mod = load_module(
+            os.path.join(EXAMPLES, "long_context_ring_attention.py"),
+            "ex_ring",
+        )
+        mod.main()  # asserts loss improvement internally (sp=4 mesh)
+
     def test_mnist_fit(self, monkeypatch, tmp_path):
         monkeypatch.setenv("MNIST_EXAMPLE_EPOCHS", "2")
         monkeypatch.setenv("MNIST_EXAMPLE_STEPS", "4")
