@@ -71,6 +71,30 @@ class TestLocalEndToEnd:
         # exit 0 therefore means conversion + mesh + training all worked.
         assert result.returncode == 0, result.stdout + result.stderr
 
+    def test_records_streaming_workload_through_bootstrap(self, tmp_path):
+        """The streaming-input golden workload (BASELINE config 5) runs
+        through the real container ENTRYPOINT on the virtual mesh: record
+        shards on disk -> RecordDataset -> prefetch -> Trainer.fit under
+        the bootstrap-installed mesh."""
+        entry = os.path.join(TESTDATA, "records_streaming_example.py")
+        report = cloud_tpu.run(
+            entry_point=entry,
+            chief_config=cloud_tpu.COMMON_MACHINE_CONFIGS["TPU"],
+            docker_config=DockerConfig(image="gcr.io/p/rec:t"),
+            dry_run=True,
+        )
+        result = local_rig.run_bootstrap(
+            entry,
+            mesh_plan_json=report.mesh_plan.to_json(),
+            extra_env={
+                "RECORDS_EXAMPLE_DIR": str(tmp_path / "data"),
+                "RECORDS_EXAMPLE_SAVE": str(tmp_path),
+            },
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        history = json.loads((tmp_path / "history.json").read_text())
+        assert history["loss"][-1] < history["loss"][0]
+
     def test_within_script_contract_remote_half(self, tmp_path):
         # Script mode, container side: the remote() guard makes run()
         # return immediately and the training below executes (the local
