@@ -17,6 +17,7 @@ from cloud_tpu.training.train import (
 from cloud_tpu.training.trainer import (
     Callback,
     EarlyStopping,
+    TerminateOnNaN,
     History,
     LambdaCallback,
     ProgressLogger,
@@ -28,6 +29,7 @@ __all__ = [
     "Trainer",
     "Callback",
     "EarlyStopping",
+    "TerminateOnNaN",
     "History",
     "LambdaCallback",
     "ProgressLogger",

@@ -393,6 +393,57 @@ class TestEarlyStopping:
         assert all(r == b for r, b in zip(flat_r, flat_b))
 
 
+class TestTerminateOnNaN:
+    def test_stops_on_nonfinite_loss(self):
+        from cloud_tpu.training import TerminateOnNaN
+
+        cfg = mnist.MnistConfig(hidden_dim=16)
+        trainer = Trainer(
+            functools.partial(mnist.loss_fn, config=cfg),
+            # Absurd LR: loss overflows to nan/inf within a few steps.
+            optax.sgd(1e18),
+            init_fn=functools.partial(mnist.init, config=cfg),
+        )
+        trainer.init_state(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        ds = data.ArrayDataset(
+            {
+                "image": (rng.normal(size=(64, 28, 28)) * 1e6).astype(
+                    np.float32
+                ),
+                "label": rng.integers(0, 10, 64),
+            },
+            batch_size=16,
+        )
+        guard = TerminateOnNaN(check_every_n_steps=1)
+        trainer.fit(ds, epochs=50, callbacks=[guard])
+        assert guard.stopped_step is not None
+        assert trainer.stop_training
+
+    def test_finite_training_untouched(self):
+        from cloud_tpu.training import TerminateOnNaN
+
+        cfg = mnist.MnistConfig(hidden_dim=16)
+        trainer = Trainer(
+            functools.partial(mnist.loss_fn, config=cfg),
+            optax.adam(1e-3),
+            init_fn=functools.partial(mnist.init, config=cfg),
+        )
+        trainer.init_state(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        ds = data.ArrayDataset(
+            {
+                "image": rng.normal(size=(64, 28, 28)).astype(np.float32),
+                "label": rng.integers(0, 10, 64),
+            },
+            batch_size=16,
+        )
+        guard = TerminateOnNaN(check_every_n_steps=1)
+        history = trainer.fit(ds, epochs=2, callbacks=[guard])
+        assert guard.stopped_step is None
+        assert len(history.history["loss"]) == 2
+
+
 class TestCheckpoint:
     def test_save_restore_round_trip(self, tmp_path):
         from cloud_tpu.training.checkpoint import CheckpointManager
@@ -413,6 +464,61 @@ class TestCheckpoint:
             restored.params["hidden"]["kernel"],
         )
         mgr.close()
+
+    def test_restore_directly_into_sharded_layout(self, tmp_path):
+        """Pod resume: a checkpoint saved from a sharded mesh restores
+        STRAIGHT into the target shardings (template = ShapeDtypeStruct +
+        NamedSharding; no replicated host copy in the middle), and the
+        restored state continues training with the same loss trajectory."""
+        from cloud_tpu.training.checkpoint import CheckpointManager
+
+        cfg = transformer.TINY
+        mesh = parallel.MeshSpec({"fsdp": 4, "tp": 2}).build()
+        logical_axes = transformer.param_logical_axes(cfg)
+        rng = np.random.default_rng(0)
+        batch = {"tokens": rng.integers(0, 255, (8, 16)).astype(np.int32)}
+
+        with parallel.use_mesh(mesh):
+            state = create_sharded_state(
+                jax.random.PRNGKey(0),
+                functools.partial(transformer.init, config=cfg),
+                optax.sgd(0.1),
+                mesh,
+                logical_axes=logical_axes,
+            )
+            step = make_train_step(
+                functools.partial(transformer.loss_fn, config=cfg, mesh=mesh),
+                optax.sgd(0.1),
+                logical_axes=logical_axes,
+                mesh=mesh,
+            )
+            sharded = train_lib.shard_batch(batch, mesh)
+            state, _ = step(state, sharded)
+            _, ref_metrics = step(
+                jax.tree_util.tree_map(lambda x: x.copy(), state), sharded
+            )
+
+            mgr = CheckpointManager(str(tmp_path / "ckpt"))
+            mgr.save(1, state)
+            mgr.wait()
+
+            template = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+                state,
+            )
+            restored = mgr.restore(1, template=template)
+            # Restored leaves carry the target shardings...
+            for got, want in zip(
+                jax.tree_util.tree_leaves(restored),
+                jax.tree_util.tree_leaves(state),
+            ):
+                assert got.sharding == want.sharding
+            # ...and training continues identically.
+            _, metrics = step(restored, sharded)
+            np.testing.assert_allclose(
+                float(metrics["loss"]), float(ref_metrics["loss"]), rtol=1e-6
+            )
+            mgr.close()
 
 
 class TestArrayDataset:
