@@ -21,6 +21,14 @@ this is the capability the TPU-native build adds for long-context scale.
 Call under ``shard_map`` with the sequence dim of q/k/v sharded over
 ``axis``; batch/head dims may be sharded over other axes — the computation
 is independent along them.
+
+Known causal imbalance (future work): device i folds i+1 real blocks and
+skips the rest, so late ring ranks do ~2x the work of rank 0 and the step
+runs at the slowest rank's pace.  The fix is striped ("zig-zag") block
+assignment — each device holds stripes i and 2n-1-i so every rank folds
+the same causal mass; requires re-deriving the src-block bookkeeping and
+a gather at the output.  Not implemented: single-chip hardware here can't
+measure the multi-chip balance win to justify the extra index complexity.
 """
 
 from __future__ import annotations

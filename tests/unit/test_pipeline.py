@@ -92,6 +92,57 @@ class TestPipelineSchedule:
             )
 
 
+class TestPpAttentionFallbackWarning:
+    def test_warns_once_when_kernel_would_have_dispatched(self, monkeypatch, caplog):
+        """Inside the pp-manual region attention degrades to the O(T^2)
+        reference; when the flash kernel WOULD have been taken (big T /
+        big score tensor) a one-time warning must fire (VERDICT r2 weak #5)."""
+        import logging
+
+        from cloud_tpu.models import layers
+        from cloud_tpu.ops import flash_attention as _  # noqa: F401
+
+        import sys
+
+        import cloud_tpu.ops.flash_attention  # noqa: F401 — ensure loaded
+
+        # NB: ``import cloud_tpu.ops.flash_attention as x`` binds the
+        # package attribute, which ops/__init__ rebinds to the function;
+        # the MODULE lives in sys.modules.
+        flash_mod = sys.modules["cloud_tpu.ops.flash_attention"]
+
+        monkeypatch.setattr(layers, "_pp_fallback_warned", False)
+        # On the CPU rig would_use_kernel is always False (backend!=tpu);
+        # force the "kernel would have run" condition itself.
+        monkeypatch.setattr(
+            flash_mod, "would_use_kernel",
+            lambda q, k, mask=None, **kw: True,
+        )
+
+        mesh = parallel.MeshSpec({"pp": 2, "dp": 4}).build()
+
+        def body(q):
+            return layers.sharded_attention(q, q, q, causal=True, mesh=mesh)
+
+        from jax.sharding import PartitionSpec as P
+
+        fn = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=P(), out_specs=P(),
+                axis_names={"pp"},
+            )
+        )
+        with caplog.at_level(logging.WARNING, logger="cloud_tpu.models.layers"):
+            fn(jnp.zeros((2, 16, 2, 8), jnp.float32))
+            # Different shape -> retrace: the guard, not the jit cache,
+            # must be what prevents a duplicate warning.
+            fn(jnp.zeros((2, 32, 2, 8), jnp.float32))
+        warnings = [
+            r for r in caplog.records if "O(T^2)" in r.getMessage()
+        ]
+        assert len(warnings) == 1
+
+
 class TestTransformerPipeline:
     """pp x fsdp x tp mesh vs single-device: same loss, same grads."""
 
