@@ -552,7 +552,10 @@ def _dispatch(q, k, v, *, causal, mask, block_q, block_k, use_pallas,
     if use_pallas is None:
         use_pallas = would_use_kernel(q, k, mask, block_q=block_q,
                                       block_k=block_k)
-    if interpret:
+    if interpret and _kernel_eligible(q, k, fitted_q, fitted_k):
+        # Force the interpreter ONLY where the kernels apply — rectangular
+        # q/k (e.g. the balanced ring's cross-chunk sub-attentions) must
+        # still fall through to the reference.
         use_pallas = True
     if not use_pallas or not mask_ok:
         if with_lse:

@@ -22,13 +22,14 @@ Call under ``shard_map`` with the sequence dim of q/k/v sharded over
 ``axis``; batch/head dims may be sharded over other axes — the computation
 is independent along them.
 
-Known causal imbalance (future work): device i folds i+1 real blocks and
-skips the rest, so late ring ranks do ~2x the work of rank 0 and the step
-runs at the slowest rank's pace.  The fix is striped ("zig-zag") block
-assignment — each device holds stripes i and 2n-1-i so every rank folds
-the same causal mass; requires re-deriving the src-block bookkeeping and
-a gather at the output.  Not implemented: single-chip hardware here can't
-measure the multi-chip balance win to justify the extra index complexity.
+Causal imbalance: with contiguous blocks, device i folds i+1 real blocks
+and skips the rest, so every ppermute-synchronized hop runs at the busiest
+rank's pace (~2x the balanced cost).  :func:`ring_attention_balanced`
+fixes this with zig-zag ("striped") block assignment — device i holds
+chunks i and 2n-1-i of a 2n-chunk split, making the per-hop causal work
+IDENTICAL across ranks (3 sub-blocks on the diagonal hop, exactly 2 on
+every other hop).  Inputs must be laid out with :func:`zigzag_indices`
+before sharding; outputs invert with ``inverse=True``.
 """
 
 from __future__ import annotations
@@ -104,10 +105,7 @@ def ring_attention(
             )
         else:
             out_blk, lse_blk = block_attention(k_blk, v_blk, False)
-        lse_new = jnp.logaddexp(lse_acc, lse_blk)  # [B, H, T]
-        w_acc = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
-        w_blk = jnp.exp(lse_blk - lse_new).transpose(0, 2, 1)[..., None]
-        return o_acc * w_acc + out_blk * w_blk, lse_new
+        return _merge_partials(o_acc, lse_acc, out_blk, lse_blk)
 
     def body(i, carry):
         o_acc, lse_acc, k_cur, v_cur = carry
@@ -133,3 +131,155 @@ def ring_attention(
 def _rotate(x, axis, n):
     perm = [(i, (i + 1) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
+
+
+def _merge_partials(o_acc, lse_acc, o_new, lse_new):
+    """Online-softmax merge of two attention partials.
+
+    ``o_*`` are [B, T, H, D] f32 UN-normalized-by-each-other outputs (each
+    already normalized within its own partial), ``lse_*`` their [B, H, T]
+    log-sum-exps.  Shared by both ring variants — the numerically delicate
+    piece lives once.
+    """
+    lse = jnp.logaddexp(lse_acc, lse_new)
+    w_acc = jnp.exp(lse_acc - lse).transpose(0, 2, 1)[..., None]
+    w_new = jnp.exp(lse_new - lse).transpose(0, 2, 1)[..., None]
+    return o_acc * w_acc + o_new * w_new, lse
+
+
+# ---------------------------------------------------------------------------
+# Load-balanced causal ring (zig-zag block assignment)
+# ---------------------------------------------------------------------------
+
+
+def zigzag_indices(seq_len: int, n: int, *, inverse: bool = False):
+    """Gather indices for the zig-zag sequence layout over ``n`` ring ranks.
+
+    The sequence splits into 2n chunks; rank i holds chunks (i, 2n-1-i).
+    ``x_zz = x[:, zigzag_indices(T, n)]`` produces the layout
+    ``ring_attention_balanced`` expects once sharded contiguously over the
+    ring axis; ``inverse=True`` gives the indices that undo it on outputs.
+    Positions fed to RoPE etc. must be permuted the same way (the tokens
+    keep their ORIGINAL global positions).
+    """
+    if seq_len % (2 * n):
+        raise ValueError(
+            f"zig-zag layout needs seq_len divisible by 2*n; got "
+            f"T={seq_len}, n={n}"
+        )
+    chunk = seq_len // (2 * n)
+    order = []
+    for i in range(n):
+        order.append(i)
+        order.append(2 * n - 1 - i)
+    forward = jnp.concatenate(
+        [jnp.arange(c * chunk, (c + 1) * chunk) for c in order]
+    )
+    if not inverse:
+        return forward
+    inv = jnp.zeros((seq_len,), jnp.int32)
+    inv = inv.at[forward].set(jnp.arange(seq_len, dtype=jnp.int32))
+    return inv
+
+
+def ring_attention_balanced(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis: str,
+    *,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """CAUSAL ring attention with per-hop load balance (zig-zag layout).
+
+    Args/returns as :func:`ring_attention`, except the local [B, T_local,
+    H, D] blocks must hold zig-zag chunks (``zigzag_indices``): rank i's
+    first half is global chunk i, its second half global chunk 2n-1-i.
+    Per hop every rank folds the same causal mass, so the ppermute
+    barrier no longer waits on the busiest rank — ~2x the throughput of
+    the contiguous causal ring at larger n.  Non-causal attention has no
+    imbalance; use :func:`ring_attention` for it.
+    """
+    n = lax.axis_size(axis)
+    my_idx = lax.axis_index(axis)
+    b, t_local, h, d = q.shape
+    if t_local % 2:
+        raise ValueError("zig-zag local block must hold two equal chunks")
+    c = t_local // 2
+    q_lo, q_hi = q[:, :c], q[:, c:]  # global chunks my_idx, 2n-1-my_idx
+
+    def attn(q_, k_, v_, causal_):
+        out, lse = flash_attention_with_lse(
+            q_, k_, v_, causal=causal_, use_pallas=use_pallas,
+            interpret=interpret,
+        )
+        return out.astype(jnp.float32), lse
+
+    def fold(carry, k_cur, v_cur, src):
+        (o_lo, l_lo, o_hi, l_hi) = carry
+        k_lo_b, k_hi_b = k_cur[:, :c], k_cur[:, c:]  # chunks src, 2n-1-src
+        v_lo_b, v_hi_b = v_cur[:, :c], v_cur[:, c:]
+
+        # Every sub-attention is a SQUARE c x c call, so each is
+        # kernel-eligible and the rectangular-dispatch hazard never
+        # arises; q_hi's two partials combine through the same lse merge
+        # as the hop accumulators.
+
+        def diagonal():
+            # Own chunks: q_lo diag vs chunk i; q_hi sees chunk i fully
+            # (i < 2n-1-i always) and its own chunk diagonally.
+            oa, la = attn(q_lo, k_lo_b, v_lo_b, True)
+            ob, lb = _merge_partials(
+                *attn(q_hi, k_lo_b, v_lo_b, False),
+                *attn(q_hi, k_hi_b, v_hi_b, True),
+            )
+            return oa, la, ob, lb
+
+        def past():  # src < my_idx: chunk src is past BOTH local q chunks
+            # (chunk 2n-1-src is future for both: 2n-1-src > 2n-1-my_idx).
+            oa, la = attn(q_lo, k_lo_b, v_lo_b, False)
+            ob, lb = attn(q_hi, k_lo_b, v_lo_b, False)
+            return oa, la, ob, lb
+
+        def future():
+            # src in (my_idx, n): chunks src and 2n-1-src are both
+            # > my_idx and both < 2n-1-my_idx — q_hi attends both fully,
+            # q_lo attends neither.
+            ob, lb = _merge_partials(
+                *attn(q_hi, k_lo_b, v_lo_b, False),
+                *attn(q_hi, k_hi_b, v_hi_b, False),
+            )
+            oa = jnp.zeros((b, c, h, d), jnp.float32)
+            la = jnp.full((b, h, c), NEG_INF, jnp.float32)
+            return oa, la, ob, lb
+
+        case = jnp.where(src == my_idx, 0, jnp.where(src < my_idx, 1, 2))
+        oa, la, ob, lb = lax.switch(case, (diagonal, past, future))
+        o_lo, l_lo = _merge_partials(o_lo, l_lo, oa, la)
+        o_hi, l_hi = _merge_partials(o_hi, l_hi, ob, lb)
+        return o_lo, l_lo, o_hi, l_hi
+
+    def body(j, carry):
+        o_lo, l_lo, o_hi, l_hi, k_cur, v_cur = carry
+        src = jax.lax.rem(my_idx - j + n, n)
+        o_lo, l_lo, o_hi, l_hi = fold(
+            (o_lo, l_lo, o_hi, l_hi), k_cur, v_cur, src
+        )
+        return (
+            o_lo, l_lo, o_hi, l_hi,
+            _rotate(k_cur, axis, n), _rotate(v_cur, axis, n),
+        )
+
+    o_lo = jnp.zeros((b, c, h, d), jnp.float32)
+    l_lo = jnp.full((b, h, c), NEG_INF, jnp.float32)
+    o_hi = jnp.zeros((b, c, h, d), jnp.float32)
+    l_hi = jnp.full((b, h, c), NEG_INF, jnp.float32)
+    o_lo, l_lo, o_hi, l_hi, k_last, v_last = lax.fori_loop(
+        0, n - 1, body, (o_lo, l_lo, o_hi, l_hi, k, v)
+    )
+    o_lo, l_lo, o_hi, l_hi = fold(
+        (o_lo, l_lo, o_hi, l_hi), k_last, v_last,
+        jax.lax.rem(my_idx - (n - 1) + n, n),
+    )
+    return jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
