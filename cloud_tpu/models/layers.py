@@ -161,7 +161,8 @@ def _warn_pp_attention_fallback(shape):
 
 def sharded_attention(q, k, v, *, causal: bool,
                       mask: Optional[jnp.ndarray] = None,
-                      rules: ShardingRules = DEFAULT_RULES, mesh=None):
+                      rules: ShardingRules = DEFAULT_RULES, mesh=None,
+                      zigzag: bool = False):
     """Mesh-aware attention dispatch over [B, T, H, D] tensors.
 
     The single routing point shared by CloudLM and BERT:
@@ -209,11 +210,21 @@ def sharded_attention(q, k, v, *, causal: bool,
         return ops.flash_attention(q, k, v, causal=causal, mask=mask,
                                    use_pallas=False)
     if sp_size > 1 and mask is None:
+        from cloud_tpu.parallel.ring_attention import ring_attention_balanced
+
         batch_axes = rules.assignment("batch")
         heads_axes = rules.assignment("heads")
         spec = PartitionSpec(batch_axes, mesh_lib.AXIS_SP, heads_axes, None)
+        if zigzag and causal:
+            # Caller guarantees the sequence is in zig-zag layout
+            # (zigzag_indices) — per-hop-balanced causal ring.
+            ring_fn = partial(ring_attention_balanced, axis=mesh_lib.AXIS_SP)
+        else:
+            ring_fn = partial(
+                ring_attention, axis=mesh_lib.AXIS_SP, causal=causal
+            )
         return jax.shard_map(
-            partial(ring_attention, axis=mesh_lib.AXIS_SP, causal=causal),
+            ring_fn,
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,

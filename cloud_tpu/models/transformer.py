@@ -51,6 +51,12 @@ class TransformerConfig:
     #: Microbatch count for pipeline parallelism (pp > 1); None -> pp size.
     #: Bubble fraction is (pp-1)/(M+pp-1), so raise this to amortize it.
     num_microbatches: Optional[int] = None
+    #: With sp > 1: run causal attention as the load-balanced zig-zag ring
+    #: (parallel/ring_attention.py).  apply() permutes tokens/positions
+    #: into the zig-zag layout internally and loss_fn gathers next-token
+    #: targets through the permutation — callers keep feeding sequences in
+    #: natural order.  Incompatible with pp (the pipeline path).
+    zigzag_sp: bool = False
 
     def scaled(self, **kw) -> "TransformerConfig":
         return dataclasses.replace(self, **kw)
@@ -159,7 +165,8 @@ def _attention(
     v = shard_constraint(v, "batch", "seq", "heads", None, rules=rules, mesh=mesh)
 
     attended = layers.sharded_attention(
-        q, k, v, causal=True, rules=rules, mesh=mesh
+        q, k, v, causal=True, rules=rules, mesh=mesh,
+        zigzag=config.zigzag_sp,
     )
 
     attended = attended.reshape(b, t, h * hd)
@@ -235,6 +242,12 @@ def _pipelined_stack(params, x, config, rules, mesh):
     return x, jnp.sum(aux_mbs) / m
 
 
+def _zigzag_active(config: TransformerConfig, mesh) -> bool:
+    if not config.zigzag_sp or mesh is None:
+        return False
+    return dict(mesh.shape).get(mesh_lib.AXIS_SP, 1) > 1
+
+
 def apply(
     params,
     tokens: jnp.ndarray,
@@ -243,9 +256,24 @@ def apply(
     rules: ShardingRules = DEFAULT_RULES,
     mesh=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Forward pass: tokens [B, T] -> (logits [B, T, V], aux loss scalar)."""
+    """Forward pass: tokens [B, T] -> (logits [B, T, V], aux loss scalar).
+
+    With ``config.zigzag_sp`` active, logits come back in the ZIG-ZAG
+    sequence order (slot j corresponds to global position
+    ``zigzag_indices(T, sp)[j]``) — ``loss_fn`` accounts for it; callers
+    reading logits directly must gather through the inverse permutation.
+    """
     mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
     b, t = tokens.shape
+    zigzag = _zigzag_active(config, mesh)
+    if zigzag:
+        if _is_pipelined(config, rules, mesh):
+            raise ValueError("zigzag_sp is incompatible with pp pipelining")
+        from cloud_tpu.parallel.ring_attention import zigzag_indices
+
+        sp = dict(mesh.shape)[mesh_lib.AXIS_SP]
+        perm = zigzag_indices(t, sp)
+        tokens = jnp.take(tokens, perm, axis=1)
     x = layers.embedding_apply(params["embed"], tokens, dtype=config.dtype,
                                rules=rules, mesh=mesh)
     x = x * math.sqrt(config.dim)
@@ -254,7 +282,10 @@ def apply(
     if _is_pipelined(config, rules, mesh):
         x, aux = _pipelined_stack(params, x, config, rules, mesh)
     else:
-        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+        positions = (
+            jnp.broadcast_to(perm, (b, t)) if zigzag
+            else jnp.broadcast_to(jnp.arange(t), (b, t))
+        )
 
         def layer_body(carry, layer_params):
             x, aux = carry
@@ -286,19 +317,34 @@ def loss_fn(
     mesh=None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Next-token cross-entropy; batch = {"tokens": [B, T]} (optionally
-    "loss_mask" [B, T])."""
+    "loss_mask" [B, T], gating the loss at each TARGET position)."""
     tokens = batch["tokens"]
+    mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
     logits, aux = apply(params, tokens, config, rules=rules, mesh=mesh)
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
+    mask = batch.get("loss_mask")
+    t = tokens.shape[1]
+
+    # Both layouts reduce to: slot j predicts global position pos[j] + 1,
+    # with the final position carrying no target.  Natural order is the
+    # identity permutation; zig-zag gathers targets through the
+    # permutation rather than unpermuting the [B, T, V] logits (which
+    # would all-to-all across sp shards).
+    if _zigzag_active(config, mesh):
+        from cloud_tpu.parallel.ring_attention import zigzag_indices
+
+        pos = zigzag_indices(t, dict(mesh.shape)[mesh_lib.AXIS_SP])
+    else:
+        pos = jnp.arange(t)
+    target_idx = jnp.clip(pos + 1, max=t - 1)
+    targets = jnp.take(tokens, target_idx, axis=1)
+    weights = (pos < t - 1).astype(jnp.float32)[None, :]  # [1, T]
+    if mask is not None:
+        weights = weights * jnp.take(
+            mask.astype(jnp.float32), target_idx, axis=1
+        )
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("loss_mask")
-    if mask is not None:
-        mask = mask[:, 1:].astype(jnp.float32)
-        denom = jnp.clip(jnp.sum(mask), 1.0)
-        ce = jnp.sum(nll * mask) / denom
-    else:
-        ce = jnp.mean(nll)
+    weights = jnp.broadcast_to(weights, nll.shape)
+    ce = jnp.sum(nll * weights) / jnp.clip(jnp.sum(weights), 1.0)
     loss = ce + aux
     return loss, {"loss": loss, "ce": ce, "aux": aux}

@@ -553,9 +553,9 @@ def _dispatch(q, k, v, *, causal, mask, block_q, block_k, use_pallas,
         use_pallas = would_use_kernel(q, k, mask, block_q=block_q,
                                       block_k=block_k)
     if interpret and _kernel_eligible(q, k, fitted_q, fitted_k):
-        # Force the interpreter ONLY where the kernels apply — rectangular
-        # q/k (e.g. the balanced ring's cross-chunk sub-attentions) must
-        # still fall through to the reference.
+        # Force the interpreter ONLY where the kernels apply — shapes the
+        # kernels can't express (rectangular q/k, oversize head_dim,
+        # unalignable T) must still fall through to the reference.
         use_pallas = True
     if not use_pallas or not mask_ok:
         if with_lse:

@@ -172,6 +172,51 @@ class TestBalancedRingAttention:
         with pytest.raises(ValueError, match="divisible"):
             zigzag_indices(30, 4)
 
+    def test_transformer_zigzag_matches_unsharded(self):
+        """config.zigzag_sp end to end: loss AND param grads on an sp=4
+        mesh equal the single-device natural-order baseline (callers feed
+        natural-order tokens; the model owns the permutation)."""
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, zigzag_sp=True)
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(3)
+        batch = {
+            "tokens": rng.integers(0, 255, (2, 64)).astype(np.int32),
+            "loss_mask": (rng.random((2, 64)) > 0.2).astype(np.float32),
+        }
+
+        ref_cfg = transformer.TINY.scaled(dtype=jnp.float32)
+        loss_ref, grads_ref = jax.value_and_grad(
+            lambda p: transformer.loss_fn(p, batch, ref_cfg, mesh=None)[0]
+        )(params)
+
+        mesh = parallel.MeshSpec({"sp": 4}).build(jax.devices()[:4])
+        with parallel.use_mesh(mesh):
+            sharded = train_lib.shard_batch(batch, mesh)
+            loss_zz, grads_zz = jax.jit(
+                jax.value_and_grad(
+                    lambda p: transformer.loss_fn(
+                        p, sharded, cfg, mesh=mesh
+                    )[0]
+                )
+            )(params)
+        np.testing.assert_allclose(float(loss_zz), float(loss_ref), rtol=1e-5)
+        for g, rg in zip(
+            jax.tree_util.tree_leaves(grads_zz),
+            jax.tree_util.tree_leaves(grads_ref),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(rg), atol=1e-4, rtol=5e-3
+            )
+
+    def test_zigzag_with_pp_raises(self):
+        cfg = transformer.TINY.scaled(zigzag_sp=True)
+        mesh = parallel.MeshSpec({"pp": 2, "sp": 2, "dp": 2}).build()
+        rules = parallel.DEFAULT_RULES.extended(layers="pp")
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((4, 32), jnp.int32)
+        with pytest.raises(ValueError, match="incompatible"):
+            transformer.apply(params, tokens, cfg, rules=rules, mesh=mesh)
+
 
 class TestTransformer:
     def test_forward_shapes(self):
