@@ -33,8 +33,11 @@ def main():
     mesh = parallel.MeshSpec({"fsdp": max(n // 4, 1), "sp": 4}).build()
     print(f"mesh: {[f'{a}={s}' for a, s in mesh.shape.items() if s > 1]}")
 
-    config = transformer.TINY  # seq_len scales to millions on real slices
-    seq_len = 128  # divisible by sp=4 -> 32 tokens per device
+    # zigzag_sp: causal attention runs as the LOAD-BALANCED zig-zag ring
+    # (every rank folds the same causal mass per hop); data stays in
+    # natural order — the model owns the layout permutation.
+    config = transformer.TINY.scaled(zigzag_sp=True)
+    seq_len = 128  # divisible by 2*sp=8 -> zig-zag chunks of 16
 
     trainer = Trainer(
         functools.partial(transformer.loss_fn, config=config, mesh=mesh),
