@@ -181,11 +181,19 @@ def get_global_mesh() -> Optional[Mesh]:
 
 @contextlib.contextmanager
 def use_mesh(mesh: Mesh):
-    """Install ``mesh`` as the global mesh for the duration of the block."""
+    """Install ``mesh`` as the global mesh for the duration of the block.
+
+    Enters via ``jax.set_mesh`` (the sharding-in-types context), not the
+    legacy ``with mesh:`` block: under the legacy context the GSPMD
+    partitioner CHECK-fails on custom_partitioning calls inside a
+    partial-manual region (spmd_partitioner_util.cc "num_devices_per_group"
+    — the pipelined flash-attention path), while the modern context
+    partitions them correctly.
+    """
     prev = get_global_mesh()
     set_global_mesh(mesh)
     try:
-        with mesh:
+        with jax.set_mesh(mesh):
             yield mesh
     finally:
         set_global_mesh(prev)

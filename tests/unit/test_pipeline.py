@@ -92,16 +92,12 @@ class TestPipelineSchedule:
             )
 
 
-class TestPpAttentionFallbackWarning:
-    def test_warns_once_when_kernel_would_have_dispatched(self, monkeypatch, caplog):
-        """Inside the pp-manual region attention degrades to the O(T^2)
-        reference; when the flash kernel WOULD have been taken (big T /
-        big score tensor) a one-time warning must fire (VERDICT r2 weak #5)."""
-        import logging
+class TestPartitionedKernelInPipelineRegion:
+    """The flash kernel must run INSIDE the pp-manual region via
+    custom_partitioning — no O(T^2) fallback, no nested shard_map
+    (VERDICT r2 weak #5's "restructure" option)."""
 
-        from cloud_tpu.models import layers
-        from cloud_tpu.ops import flash_attention as _  # noqa: F401
-
+    def _flash_mod(self):
         import sys
 
         import cloud_tpu.ops.flash_attention  # noqa: F401 — ensure loaded
@@ -109,38 +105,179 @@ class TestPpAttentionFallbackWarning:
         # NB: ``import cloud_tpu.ops.flash_attention as x`` binds the
         # package attribute, which ops/__init__ rebinds to the function;
         # the MODULE lives in sys.modules.
-        flash_mod = sys.modules["cloud_tpu.ops.flash_attention"]
+        return sys.modules["cloud_tpu.ops.flash_attention"]
 
-        monkeypatch.setattr(layers, "_pp_fallback_warned", False)
-        # On the CPU rig would_use_kernel is always False (backend!=tpu);
-        # force the "kernel would have run" condition itself.
-        monkeypatch.setattr(
-            flash_mod, "would_use_kernel",
-            lambda q, k, mask=None, **kw: True,
+    def test_kernel_matches_reference_inside_pp_region(self):
+        """Interpret-mode kernels under the pp-manual shard_map with dp/tp
+        auto axes sharded: forward AND gradient match the reference."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from cloud_tpu import ops
+        from cloud_tpu.ops.flash_attention import _reference
+
+        flash_mod = self._flash_mod()
+        mesh = parallel.MeshSpec({"pp": 2, "dp": 2, "tp": 2}).build()
+        rng = np.random.default_rng(0)
+        shape = (4, 64, 4, 8)  # [B, T, H, D]
+        q, k, v = (
+            jnp.asarray(rng.normal(size=shape), jnp.float32) * 0.1
+            for _ in range(3)
         )
+        sharding = NamedSharding(mesh, P("dp", None, "tp", None))
+        q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+
+        def pp_body(q, k, v):
+            return ops.flash_attention(
+                q, k, v, causal=True, partitioned=True, use_pallas=True,
+                interpret=True, block_q=32, block_k=32,
+            )
+
+        def loss(q, k, v):
+            out = jax.shard_map(
+                pp_body, mesh=mesh, in_specs=(P(),) * 3, out_specs=P(),
+                axis_names={"pp"},
+            )(q, k, v)
+            return jnp.sum(out * out)
+
+        def ref_loss(q, k, v):
+            out = _reference(q, k, v, causal=True, mask=None)
+            return jnp.sum(out * out)
+
+        before = flash_mod.KERNEL_TRACE_COUNT
+        with parallel.use_mesh(mesh):
+            got = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(
+                q, k, v
+            )
+        want = jax.jit(jax.value_and_grad(ref_loss, argnums=(0, 1, 2)))(
+            q, k, v
+        )
+        assert flash_mod.KERNEL_TRACE_COUNT > before, (
+            "pallas kernels were never traced — the cp path fell back"
+        )
+        np.testing.assert_allclose(float(got[0]), float(want[0]), rtol=1e-5)
+        for g, w in zip(got[1], want[1]):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-4, atol=5e-5
+            )
+
+    def test_masked_kernel_matches_reference_inside_pp_region(self):
+        """The padding-mask variant (BERT-style) must also partition: the
+        mask is a 4th cp operand with its own (b, t) mapping."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from cloud_tpu import ops
+        from cloud_tpu.ops.flash_attention import _reference
+
+        mesh = parallel.MeshSpec({"pp": 2, "dp": 2, "tp": 2}).build()
+        rng = np.random.default_rng(1)
+        shape = (4, 64, 4, 8)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=shape), jnp.float32) * 0.1
+            for _ in range(3)
+        )
+        mask = jnp.asarray(
+            rng.integers(0, 2, (shape[0], shape[1])), jnp.int32
+        )
+        # Keep at least one valid key per row (fully-masked rows produce
+        # uniform garbage by contract).
+        mask = mask.at[:, 0].set(1)
+        sharding = NamedSharding(mesh, P("dp", None, "tp", None))
+        q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+
+        def pp_body(q, k, v, m):
+            return ops.flash_attention(
+                q, k, v, causal=False, mask=m, partitioned=True,
+                use_pallas=True, interpret=True, block_q=32, block_k=32,
+            )
+
+        def loss(q, k, v, m):
+            out = jax.shard_map(
+                pp_body, mesh=mesh, in_specs=(P(),) * 4, out_specs=P(),
+                axis_names={"pp"},
+            )(q, k, v, m)
+            return jnp.sum(out * out)
+
+        def ref_loss(q, k, v, m):
+            out = _reference(q, k, v, causal=False, mask=m)
+            return jnp.sum(out * out)
+
+        with parallel.use_mesh(mesh):
+            got = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(
+                q, k, v, mask
+            )
+        want = jax.jit(jax.value_and_grad(ref_loss, argnums=(0, 1, 2)))(
+            q, k, v, mask
+        )
+        np.testing.assert_allclose(float(got[0]), float(want[0]), rtol=1e-5)
+        for g, w in zip(got[1], want[1]):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-4, atol=5e-5
+            )
+
+    def test_sharded_attention_routes_partitioned_in_manual_context(
+        self, monkeypatch
+    ):
+        """sharded_attention's manual-context branch must pass
+        partitioned=True to ops.flash_attention (the dispatch seam the
+        kernel path hangs off)."""
+        from cloud_tpu.models import layers
+        from cloud_tpu import ops as ops_pkg
+
+        seen = {}
+
+        def spy(q, k, v, **kwargs):
+            seen.update(kwargs)
+            from cloud_tpu.ops.flash_attention import _reference
+
+            return _reference(q, k, v, causal=kwargs.get("causal", True),
+                              mask=kwargs.get("mask"))
+
+        monkeypatch.setattr(ops_pkg, "flash_attention", spy)
+
+        from jax.sharding import PartitionSpec as P
 
         mesh = parallel.MeshSpec({"pp": 2, "dp": 4}).build()
 
         def body(q):
             return layers.sharded_attention(q, q, q, causal=True, mesh=mesh)
 
-        from jax.sharding import PartitionSpec as P
-
-        fn = jax.jit(
+        jax.jit(
             jax.shard_map(
                 body, mesh=mesh, in_specs=P(), out_specs=P(),
                 axis_names={"pp"},
             )
+        )(jnp.zeros((2, 16, 2, 8), jnp.float32))
+        assert seen.get("partitioned") is True
+
+    def test_transformer_pp_forward_with_kernels(self, monkeypatch):
+        """End-to-end: the pipelined transformer with force-interpret
+        kernels matches the unpipelined f32 reference — proves the cp
+        kernels compose with the pipeline's vma-checked fori_loop."""
+        flash_mod = self._flash_mod()
+        monkeypatch.setenv("CLOUD_TPU_FLASH_FORCE_INTERPRET", "1")
+
+        config = transformer.TINY.scaled(dtype=jnp.float32)
+        params = transformer.init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(0)
+        batch = {"tokens": rng.integers(0, 255, (8, 32)).astype(np.int32)}
+
+        loss_ref, _ = transformer.loss_fn(params, batch, config, mesh=None)
+
+        mesh = parallel.MeshSpec({"pp": 2, "fsdp": 2, "tp": 2}).build()
+        rules = parallel.DEFAULT_RULES.extended(layers="pp")
+        before = flash_mod.KERNEL_TRACE_COUNT
+        with parallel.use_mesh(mesh):
+            sharded_batch = train_lib.shard_batch(batch, mesh, rules)
+            loss_pp, _ = jax.jit(
+                functools.partial(
+                    transformer.loss_fn, config=config, rules=rules,
+                    mesh=mesh,
+                )
+            )(params, sharded_batch)
+        assert flash_mod.KERNEL_TRACE_COUNT > before
+        np.testing.assert_allclose(
+            float(loss_ref), float(loss_pp), rtol=1e-5
         )
-        with caplog.at_level(logging.WARNING, logger="cloud_tpu.models.layers"):
-            fn(jnp.zeros((2, 16, 2, 8), jnp.float32))
-            # Different shape -> retrace: the guard, not the jit cache,
-            # must be what prevents a duplicate warning.
-            fn(jnp.zeros((2, 32, 2, 8), jnp.float32))
-        warnings = [
-            r for r in caplog.records if "O(T^2)" in r.getMessage()
-        ]
-        assert len(warnings) == 1
 
 
 class TestTransformerPipeline:
