@@ -247,25 +247,37 @@ def _check_flash_attention(extras):
     )
 
     def loss(q, k, v, use_pallas):
-        # Both entry points in one program: the plain kernel plus the
-        # (out, lse) variant with a nonzero lse cotangent (ring's merge).
+        # All three entry points in one program: the plain kernel, the
+        # (out, lse) variant with a nonzero lse cotangent (ring's merge),
+        # and the custom_partitioning dispatch (the pipeline-region /
+        # mesh-auto path; use_pallas=False compares it as reference too).
         out = flash_attention(q, k, v, causal=True, use_pallas=use_pallas)
         out2, lse = flash_attention_with_lse(
             q, k, v, causal=False, use_pallas=use_pallas
+        )
+        out3 = flash_attention(
+            q, k, v, causal=True, use_pallas=use_pallas, partitioned=True
         )
         return (
             jnp.mean(out.astype(jnp.float32) ** 2)
             + jnp.mean(out2.astype(jnp.float32) ** 2)
             + 0.3 * jnp.mean(jnp.sin(lse))
+            + jnp.mean(out3.astype(jnp.float32) ** 2)
         )
 
+    from jax.sharding import Mesh
+    import numpy as _np
+
+    # The partitioned dispatch needs a mesh context to resolve against.
+    mesh = Mesh(_np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "tp"))
     grad_fn = jax.value_and_grad(loss, argnums=(0, 1, 2))
-    val_kernel, grads_kernel = jax.jit(
-        lambda q, k, v: grad_fn(q, k, v, True)
-    )(q, k, v)
-    val_ref, grads_ref = jax.jit(
-        lambda q, k, v: grad_fn(q, k, v, False)
-    )(q, k, v)
+    with jax.set_mesh(mesh):
+        val_kernel, grads_kernel = jax.jit(
+            lambda q, k, v: grad_fn(q, k, v, True)
+        )(q, k, v)
+        val_ref, grads_ref = jax.jit(
+            lambda q, k, v: grad_fn(q, k, v, False)
+        )(q, k, v)
 
     def close(a, b):
         a = jnp.asarray(a, jnp.float32)
