@@ -1,0 +1,308 @@
+"""Autoregressive generation for CloudLM: prefill + KV-cache decode.
+
+TPU-first decode loop: the whole generation is ONE ``lax.scan`` — static
+trip count, static shapes, no host round-trips — so XLA compiles a single
+program for the full sampling run.  The KV cache is a pair of
+``[L, B, S, H, hd]`` buffers carried through the scan; each step appends
+one position per sequence (per-row ``cur_len`` write indices lower to a
+scatter, so ragged prompt lengths need no host-side padding games).
+
+The reference has no inference path at all (it launches training jobs —
+SURVEY.md §1); this module is framework capability beyond parity, built
+on the same layer primitives as training (``transformer.qkv_project``,
+``layers.rmsnorm_apply``) so cache decode is numerically equivalent to a
+full re-forward — tested against exactly that in
+tests/unit/test_generation.py.
+
+Sharding: under a mesh, batch shards over dp/fsdp and heads over tp via
+the usual logical-axis constraints.  ``pp``/``zigzag_sp`` layouts are
+training-only and rejected up front.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from cloud_tpu.models import layers, moe as moe_lib
+from cloud_tpu.models import transformer
+from cloud_tpu.parallel import mesh as mesh_lib
+from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules, shard_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleConfig:
+    """Sampling hyperparameters (all static — they specialize the compile).
+
+    ``temperature=0`` means greedy (argmax); ``top_k``/``top_p`` are
+    applied in that order when set.  ``eos_id`` stops a sequence: the eos
+    token itself is emitted, and every slot after it holds ``pad_id``.
+    """
+
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_id: Optional[int] = None
+    pad_id: int = 0
+
+
+def sample_logits(rng, logits, sample: SampleConfig):
+    """One sampling step: logits [B, V] f32 -> token ids [B]."""
+    if sample.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / sample.temperature
+    if sample.top_k is not None:
+        kth = jax.lax.top_k(logits, sample.top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if sample.top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with mass >= top_p (the cutoff token
+        # itself stays includable, hence the shift-by-one).
+        keep = cumulative - probs < sample.top_p
+        threshold = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def _init_cache(config: transformer.TransformerConfig, b: int, s: int,
+                rules: ShardingRules, mesh):
+    shape = (config.num_layers, b, s, config.num_heads, config.head_dim)
+    k = jnp.zeros(shape, config.dtype)
+    v = jnp.zeros(shape, config.dtype)
+    k = shard_constraint(k, None, "batch", None, "heads", None,
+                         rules=rules, mesh=mesh)
+    v = shard_constraint(v, None, "batch", None, "heads", None,
+                         rules=rules, mesh=mesh)
+    return {"k": k, "v": v}
+
+
+def _cache_attention(q, k_cache, v_cache, cur_len):
+    """q [B, Tq, H, hd] against the cache [B, S, H, hd]; key j of row i is
+    valid iff j < cur_len[i].  f32 softmax, finite mask value (matching
+    ops.flash_attention's semantics for fully-masked rows)."""
+    s = k_cache.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+        k_cache.astype(jnp.float32),
+    ) * scale
+    valid = jnp.arange(s)[None, :] < cur_len[:, None]  # [B, S]
+    scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights, v_cache.astype(jnp.float32)
+    )
+    return out.astype(q.dtype)
+
+
+def _mlp(layer_params, y, config, rules):
+    if config.moe is not None:
+        out, _ = moe_lib.moe_mlp_apply(layer_params["mlp"], y, config.moe)
+        return out
+    return layers.mlp_block_apply(layer_params["mlp"], y, rules=rules)
+
+
+def _decode_layer(layer_params, x, k_cache_l, v_cache_l, cur_len, config,
+                  rules):
+    """One block on a single-token slice x [B, 1, D]; writes this step's
+    k/v at position cur_len[i] and attends over the whole valid prefix
+    (including the just-written position)."""
+    b = x.shape[0]
+    y = layers.rmsnorm_apply(layer_params["ln1"], x)
+    q, k_new, v_new = transformer.qkv_project(
+        layer_params["att"], y, cur_len[:, None], config
+    )
+    rows = jnp.arange(b)
+    k_cache_l = k_cache_l.at[rows, cur_len].set(k_new[:, 0])
+    v_cache_l = v_cache_l.at[rows, cur_len].set(v_new[:, 0])
+    attended = _cache_attention(q, k_cache_l, v_cache_l, cur_len + 1)
+    att_out = layers.dense_apply(
+        layer_params["att"]["out"], attended.reshape(b, 1, -1)
+    )
+    x = x + att_out
+    y = layers.rmsnorm_apply(layer_params["ln2"], x)
+    x = x + _mlp(layer_params, y, config, rules)
+    return x, k_cache_l, v_cache_l
+
+
+def _prefill_layer(layer_params, x, positions, prompt_mask, config, rules,
+                   mesh):
+    """One block on the full prompt buffer [B, T, D], returning the
+    block's k/v for the cache.  Causal attention with the padding mask
+    applied key-side (padded tail slots are later overwritten by decode
+    before they can ever be attended)."""
+    from cloud_tpu import ops
+
+    b, t, _ = x.shape
+    y = layers.rmsnorm_apply(layer_params["ln1"], x)
+    q, k, v = transformer.qkv_project(layer_params["att"], y, positions,
+                                      config)
+    attended = ops.flash_attention(
+        q, k, v, causal=True, mask=prompt_mask,
+        partitioned=mesh is not None,
+    )
+    att_out = layers.dense_apply(
+        layer_params["att"]["out"], attended.reshape(b, t, -1)
+    )
+    x = x + att_out
+    y = layers.rmsnorm_apply(layer_params["ln2"], x)
+    x = x + _mlp(layer_params, y, config, rules)
+    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules,
+                         mesh=mesh)
+    return x, k, v
+
+
+def _final_logits(params, x, config):
+    x = layers.rmsnorm_apply(params["ln_f"], x)
+    return layers.dense_apply(params["head"], x, dtype=jnp.float32)
+
+
+def generate(
+    params,
+    prompt_tokens: jnp.ndarray,
+    prompt_lens: jnp.ndarray,
+    config: transformer.TransformerConfig,
+    *,
+    max_new_tokens: int,
+    sample: SampleConfig = SampleConfig(temperature=0.0),
+    rng: Optional[jax.Array] = None,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+) -> Dict[str, Any]:
+    """Generate ``max_new_tokens`` continuations for a batch of prompts.
+
+    Args:
+      prompt_tokens: [B, T_prompt] left-aligned token ids (rows shorter
+        than T_prompt padded arbitrarily on the right).
+      prompt_lens: [B] actual prompt lengths (1 <= len <= T_prompt).
+      max_new_tokens: static decode trip count.
+      sample: sampling configuration; default greedy.
+      rng: PRNG key (required unless greedy).
+
+    Returns dict with:
+      ``tokens``: [B, max_new_tokens] generated ids — eos included where
+        sampled, pad in every slot after it,
+      ``sequences``: [B, T_prompt + max_new_tokens] prompt + generation
+        stitched at each row's true length (pad elsewhere),
+      ``num_generated``: [B] count of generated tokens including the eos.
+    """
+    mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
+    if transformer._is_pipelined(config, rules, mesh):
+        raise ValueError(
+            "generation runs the scanned layer stack; pp pipelining is "
+            "training-only (drop the layers->pp rule for inference)"
+        )
+    if transformer._zigzag_active(config, mesh):
+        raise ValueError("zigzag_sp is training-only; disable for generation")
+    if sample.temperature != 0.0 and rng is None:
+        raise ValueError("non-greedy sampling needs an rng key")
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+
+    b, t_prompt = prompt_tokens.shape
+    s = t_prompt + max_new_tokens
+    cache = _init_cache(config, b, s, rules, mesh)
+    prompt_lens = prompt_lens.astype(jnp.int32)
+
+    # --- prefill: one full forward over the prompt buffer ---
+    positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
+    prompt_mask = (positions < prompt_lens[:, None]).astype(jnp.int32)
+    x = layers.embedding_apply(params["embed"], prompt_tokens,
+                               dtype=config.dtype, rules=rules, mesh=mesh)
+    x = x * math.sqrt(config.dim)
+    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules,
+                         mesh=mesh)
+
+    def prefill_body(x, layer_slice):
+        layer_params, = layer_slice
+        x, k, v = _prefill_layer(layer_params, x, positions, prompt_mask,
+                                 config, rules, mesh)
+        return x, (k, v)
+
+    x, (k_pref, v_pref) = jax.lax.scan(
+        prefill_body, x, (params["layers"],)
+    )
+    cache["k"] = jax.lax.dynamic_update_slice(
+        cache["k"], k_pref.astype(config.dtype), (0, 0, 0, 0, 0)
+    )
+    cache["v"] = jax.lax.dynamic_update_slice(
+        cache["v"], v_pref.astype(config.dtype), (0, 0, 0, 0, 0)
+    )
+
+    # First sampled token comes from the logits at each row's last real
+    # prompt position.
+    last_idx = (prompt_lens - 1)[:, None, None]
+    last_x = jnp.take_along_axis(
+        x, jnp.broadcast_to(last_idx, (b, 1, x.shape[-1])), axis=1
+    )
+    logits0 = _final_logits(params, last_x, config)[:, 0]
+    rng, step_rng = jax.random.split(rng)
+    tok0 = sample_logits(step_rng, logits0, sample).astype(jnp.int32)
+
+    # --- decode: one lax.scan over max_new_tokens steps ---
+    # ``post_eos`` marks tokens STRICTLY after an eos: the eos itself is a
+    # real emitted token; later slots are pads whose compute is discarded.
+    def step(carry, _):
+        cache_k, cache_v, cur_len, token, post_eos, rng = carry
+        x = layers.embedding_apply(
+            params["embed"], token[:, None], dtype=config.dtype,
+            rules=rules, mesh=mesh,
+        )
+        x = x * math.sqrt(config.dim)
+
+        def layer_body(x, layer_slice):
+            layer_params, k_l, v_l = layer_slice
+            x, k_l, v_l = _decode_layer(
+                layer_params, x, k_l, v_l, cur_len, config, rules
+            )
+            return x, (k_l, v_l)
+
+        x, (cache_k, cache_v) = jax.lax.scan(
+            layer_body, x, (params["layers"], cache_k, cache_v)
+        )
+        logits = _final_logits(params, x, config)[:, 0]
+        rng, step_rng = jax.random.split(rng)
+        next_tok = sample_logits(step_rng, logits, sample).astype(jnp.int32)
+        done = post_eos
+        if sample.eos_id is not None:
+            done = post_eos | (token == sample.eos_id)
+        next_tok = jnp.where(done, jnp.int32(sample.pad_id), next_tok)
+        cur_len = cur_len + jnp.where(post_eos, 0, 1)
+        emitted = jnp.where(post_eos, jnp.int32(sample.pad_id), token)
+        return (cache_k, cache_v, cur_len, next_tok, done, rng), emitted
+
+    carry0 = (cache["k"], cache["v"], prompt_lens, tok0,
+              jnp.zeros((b,), bool), rng)
+    (_, _, final_len, _, _, _), emitted = jax.lax.scan(
+        step, carry0, None, length=max_new_tokens
+    )
+    tokens = emitted.T  # [B, max_new_tokens]
+
+    # Stitch prompt + generation at each row's true offset.  ``tokens`` is
+    # already pad-masked past the eos, so the scatter needs no validity
+    # gating.
+    cols = jnp.arange(t_prompt)[None, :]
+    prompt_clean = jnp.where(
+        cols < prompt_lens[:, None], prompt_tokens.astype(jnp.int32),
+        jnp.int32(sample.pad_id),
+    )
+    sequences = jnp.concatenate(
+        [prompt_clean,
+         jnp.full((b, max_new_tokens), sample.pad_id, jnp.int32)],
+        axis=1,
+    )
+    gen_cols = prompt_lens[:, None] + jnp.arange(max_new_tokens)[None, :]
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], gen_cols.shape)
+    sequences = sequences.at[rows, gen_cols].set(tokens)
+    return {
+        "tokens": tokens,
+        "sequences": sequences,
+        "num_generated": final_len - prompt_lens,
+    }

@@ -142,10 +142,11 @@ def _layer_init_axes(config: TransformerConfig):
     return None, axes
 
 
-def _attention(
-    x, att_params, config: TransformerConfig, rules: ShardingRules,
-    mesh, positions,
-):
+def qkv_project(att_params, x, positions, config: TransformerConfig):
+    """RoPE'd q/k and v projections [B, T, H, hd] — shared between the
+    training forward pass and the generation path's prefill/decode (which
+    must produce bit-identical projections for the KV cache to be
+    equivalent to a full re-forward)."""
     b, t, _ = x.shape
     h, hd = config.num_heads, config.head_dim
 
@@ -160,6 +161,16 @@ def _attention(
         proj(att_params["k"]), positions, base=config.rope_base
     )
     v = proj(att_params["v"])
+    return q, k, v
+
+
+def _attention(
+    x, att_params, config: TransformerConfig, rules: ShardingRules,
+    mesh, positions,
+):
+    b, t, _ = x.shape
+    h, hd = config.num_heads, config.head_dim
+    q, k, v = qkv_project(att_params, x, positions, config)
     q = shard_constraint(q, "batch", "seq", "heads", None, rules=rules, mesh=mesh)
     k = shard_constraint(k, "batch", "seq", "heads", None, rules=rules, mesh=mesh)
     v = shard_constraint(v, "batch", "seq", "heads", None, rules=rules, mesh=mesh)
