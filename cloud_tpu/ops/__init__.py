@@ -8,5 +8,6 @@ is automatic.
 """
 
 from cloud_tpu.ops.flash_attention import flash_attention
+from cloud_tpu.ops.group_norm import group_norm
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "group_norm"]

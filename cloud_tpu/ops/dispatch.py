@@ -1,0 +1,41 @@
+"""Shared dispatch plumbing for the Pallas kernel modules.
+
+Two rules every kernel module needs identically:
+
+- :func:`force_interpret` — the ``CLOUD_TPU_FLASH_FORCE_INTERPRET=1`` env
+  contract (CPU rigs — the unit suite, the driver's virtual-mesh dryrun —
+  set it to exercise real kernel code paths through the Pallas interpreter
+  instead of silently taking jnp references).  One implementation so the
+  contract cannot drift between ops.
+- :func:`passthrough_callbacks` — the custom_partitioning callback pair
+  for kernels whose Shardy rule already forces every non-batch factor to
+  replicate: operand shardings are reused verbatim (inside a
+  partial-manual region they arrive as opaque GSPMDShardings with no
+  ``.spec`` — do NOT rebuild PartitionSpecs from them), and every result
+  reuses operand 0's sharding (valid because the rule leaves only
+  batch-like dims sharded, and result ranks/leading dims match by
+  construction — each caller documents why).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_interpret() -> bool:
+    return os.environ.get("CLOUD_TPU_FLASH_FORCE_INTERPRET", "") == "1"
+
+
+def passthrough_callbacks(impl, n_results: int):
+    """(infer_sharding_from_operands, partition) for a rule-replicated
+    kernel: results [0..n_results) all shard like operand 0; the local
+    lowering is ``impl`` itself."""
+
+    def infer(mesh, arg_shapes, result_shape):
+        return (arg_shapes[0].sharding,) * n_results
+
+    def part(mesh, arg_shapes, result_shape):
+        arg_shardings = tuple(s.sharding for s in arg_shapes)
+        return mesh, impl, (arg_shardings[0],) * n_results, arg_shardings
+
+    return infer, part

@@ -31,6 +31,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from cloud_tpu.ops import dispatch as dispatch_lib
 import numpy as np
 from jax.experimental import pallas as pl
 
@@ -69,11 +71,10 @@ KERNEL_TRACE_COUNT = 0
 
 
 def _force_interpret() -> bool:
-    """``CLOUD_TPU_FLASH_FORCE_INTERPRET=1`` runs every eligible dispatch
-    through the Pallas interpreter — how CPU-only rigs (the unit suite, the
-    driver's virtual-mesh dryrun) exercise the real kernel code path end to
-    end instead of silently taking the jnp reference."""
-    return os.environ.get("CLOUD_TPU_FLASH_FORCE_INTERPRET", "") == "1"
+    """See ops/dispatch.py — the shared env contract."""
+    from cloud_tpu.ops.dispatch import force_interpret
+
+    return force_interpret()
 
 
 # ---------------------------------------------------------------------------
@@ -605,16 +606,10 @@ def _cp_fwd_call(causal, block_q, block_k, interpret, use_mask):
 
     fn = custom_partitioning(impl)
 
-    def infer(mesh, arg_shapes, result_shape):
-        # t/d are need-replication factors, so q's sharding tiles only
-        # (b, h) — and lse [B,H,T,1] therefore shards identically to out.
-        return (arg_shapes[0].sharding,) * 2
-
-    def part(mesh, arg_shapes, result_shape):
-        # Inside a partial-manual region these arrive as GSPMDShardings
-        # (no .spec) — reuse them verbatim rather than rebuilding specs.
-        arg_shardings = tuple(s.sharding for s in arg_shapes)
-        return mesh, impl, (arg_shardings[0],) * 2, arg_shardings
+    # t/d are need-replication factors, so q's sharding tiles only (b, h)
+    # — and lse [B,H,T,1] (rank 4, same leading dims) therefore shards
+    # identically to out; both reuse q's sharding.
+    infer, part = dispatch_lib.passthrough_callbacks(impl, 2)
 
     bhtd = ("b", "h", "t", "d")
     fn.def_partition(
@@ -648,12 +643,8 @@ def _cp_bwd_call(causal, block_q, block_k, interpret, use_mask):
 
     fn = custom_partitioning(impl)
 
-    def infer(mesh, arg_shapes, result_shape):
-        return tuple(s.sharding for s in arg_shapes[:3])
-
-    def part(mesh, arg_shapes, result_shape):
-        arg_shardings = tuple(s.sharding for s in arg_shapes)
-        return mesh, impl, arg_shardings[:3], arg_shardings
+    # dq/dk/dv all shard like q ([B,H,T,D], t/d replicated by the rule).
+    infer, part = dispatch_lib.passthrough_callbacks(impl, 3)
 
     bhtd = ("b", "h", "t", "d")
     fn.def_partition(

@@ -1,0 +1,367 @@
+"""Fused GroupNorm Pallas kernels (NHWC, per-sample grid).
+
+Why a kernel: at CIFAR scale the ResNet50 step is VPU/HBM-bound and
+GroupNorm is its largest non-conv cost (BASELINE.md "ResNet ceiling").
+XLA's lowering reads the activation twice (reduce, then normalize); the
+kernel computes group statistics and writes the normalized+affine output
+in ONE pass over VMEM-resident data — one HBM read + one write per
+sample.  The backward pass is a second kernel producing dx plus
+per-sample dscale/dbias partials (summed outside — a [B, C] reduction).
+
+Group reductions avoid the TPU-hostile [H, W, G, C/g] reshape (C/g lands
+in the lane dimension at width 2-64): the activation stays [HW, C] with
+channels in lanes, per-channel sums reduce over sublanes, and a [C, G]
+one-hot matmul folds channels into groups (MXU-friendly).
+
+Numerics match models/resnet.py's shifted-moments implementation: sums
+are computed around a per-channel pivot (the first spatial row) so the
+E[x^2]-E[x]^2 combination stays O(var) even when |mean| >> std, and the
+group variance is assembled from per-channel shifted sums exactly
+(grouped shifted-data algebra, not an approximation).
+
+Reference parity note: the reference framework has no kernels at all —
+this is TPU-native capability (SURVEY.md SS5 "perf baselines are
+established by this rebuild").
+"""
+
+from __future__ import annotations
+
+import functools
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from cloud_tpu.ops import dispatch as dispatch_lib
+
+#: Diagnostic counter (see flash_attention.KERNEL_TRACE_COUNT): bumped per
+#: kernel trace so tests can assert the fused path — not the jnp
+#: reference — actually ran.
+KERNEL_TRACE_COUNT = 0
+
+
+def _reference(x, scale, bias, num_groups, eps=1e-5):
+    """Ground truth (and non-TPU fallback) — mirrors models/resnet.py."""
+    b, h, w, c = x.shape
+    g = min(num_groups, c)
+    x32 = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    pivot = jax.lax.stop_gradient(x32[:, :1, :1, :, :1])
+    xc = x32 - pivot
+    m1c = jnp.mean(xc, axis=(1, 2, 4), keepdims=True)
+    m2c = jnp.mean(xc * xc, axis=(1, 2, 4), keepdims=True)
+    var = jnp.maximum(m2c - m1c * m1c, 0.0)
+    y = (xc - m1c) * jax.lax.rsqrt(var + eps)
+    y = y.reshape(b, h, w, c) * scale + bias
+    return y.astype(x.dtype)
+
+
+def _onehot(c: int, g: int) -> jnp.ndarray:
+    """[C, G] channel->group fold matrix.  Built from iota (traced ops,
+    not a baked array constant): custom_partitioning traces its impl with
+    an empty const list, so a materialized jnp constant would trip its
+    ``assert not consts``."""
+    cg = c // g
+    ch_group = jax.lax.broadcasted_iota(jnp.int32, (c, g), 0) // cg
+    group = jax.lax.broadcasted_iota(jnp.int32, (c, g), 1)
+    return (ch_group == group).astype(jnp.float32)
+
+
+def _fwd_kernel(x_ref, scale_ref, bias_ref, oh_ref, oht_ref, y_ref,
+                mean_ref, rstd_ref, *, eps, hw, cg):
+    x = x_ref[0].astype(jnp.float32)
+    h, w, c = x.shape
+    x2 = x.reshape(hw, c)
+    oh = oh_ref[...]
+    oht = oht_ref[...]
+    n = float(hw * cg)
+
+    pivot = x2[0:1, :]  # [1, C] per-channel shift
+    xc = x2 - pivot
+    s1 = jnp.sum(xc, axis=0, keepdims=True)        # [1, C]
+    s2 = jnp.sum(xc * xc, axis=0, keepdims=True)   # [1, C]
+
+    sum_g = (s1 + hw * pivot) @ oh                 # [1, G] true sums
+    mean_g = sum_g / n
+    mean_c = mean_g @ oht                           # [1, C]
+    d = mean_c - pivot                              # [1, C]
+    # sum_(hw,c in g) (x - m)^2 = s2 - 2 d s1 + hw d^2, folded per group.
+    var_g = (s2 - 2.0 * d * s1 + hw * d * d) @ oh / n
+    rstd_g = jax.lax.rsqrt(jnp.maximum(var_g, 0.0) + eps)
+    rstd_c = rstd_g @ oht                           # [1, C]
+
+    y = (x2 - mean_c) * rstd_c * scale_ref[...] + bias_ref[...]
+    y_ref[0] = y.reshape(h, w, c).astype(y_ref.dtype)
+    mean_ref[0] = mean_g[0]
+    rstd_ref[0] = rstd_g[0]
+
+
+def _bwd_kernel(x_ref, dy_ref, mean_ref, rstd_ref, scale_ref, oh_ref,
+                oht_ref, dx_ref, ds_ref, db_ref, *, hw, cg):
+    x = x_ref[0].astype(jnp.float32)
+    dy = dy_ref[0].astype(jnp.float32)
+    h, w, c = x.shape
+    x2 = x.reshape(hw, c)
+    dy2 = dy.reshape(hw, c)
+    oh = oh_ref[...]
+    oht = oht_ref[...]
+    n = float(hw * cg)
+
+    mean_c = mean_ref[...] @ oht                    # [1, C]
+    rstd_c = rstd_ref[...] @ oht                    # [1, C]
+    xhat = (x2 - mean_c) * rstd_c
+    dxh = dy2 * scale_ref[...]
+
+    a_c = (jnp.sum(dxh, axis=0, keepdims=True) @ oh) @ oht         # [1, C]
+    b_c = (jnp.sum(dxh * xhat, axis=0, keepdims=True) @ oh) @ oht   # [1, C]
+    dx = rstd_c * (dxh - (a_c + xhat * b_c) / n)
+    dx_ref[0] = dx.reshape(h, w, c).astype(dx_ref.dtype)
+    ds_ref[0] = jnp.sum(dy2 * xhat, axis=0)         # [C] per-sample partial
+    db_ref[0] = jnp.sum(dy2, axis=0)                # [C]
+
+
+def _block_specs(b, h, w, c, g):
+    x_spec = pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0))
+    vec_spec = pl.BlockSpec((1, c), lambda i: (0, 0))
+    oh_spec = pl.BlockSpec((c, g), lambda i: (0, 0))
+    oht_spec = pl.BlockSpec((g, c), lambda i: (0, 0))
+    stat_spec = pl.BlockSpec((1, g), lambda i: (i, 0))
+    return x_spec, vec_spec, oh_spec, oht_spec, stat_spec
+
+
+def _fwd_pallas(x, scale, bias, num_groups, eps, interpret):
+    global KERNEL_TRACE_COUNT
+    KERNEL_TRACE_COUNT += 1
+    b, h, w, c = x.shape
+    g = min(num_groups, c)
+    hw, cg = h * w, c // g
+    oh = _onehot(c, g)
+    x_spec, vec_spec, oh_spec, oht_spec, stat_spec = _block_specs(b, h, w, c, g)
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, hw=hw, cg=cg),
+        grid=(b,),
+        in_specs=[x_spec, vec_spec, vec_spec, oh_spec, oht_spec],
+        out_specs=[x_spec, stat_spec, stat_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct((b, g), jnp.float32),
+            jax.ShapeDtypeStruct((b, g), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, scale.reshape(1, c), bias.reshape(1, c), oh, oh.T)
+    return y, mean, rstd
+
+
+def _bwd_pallas(x, dy, mean, rstd, scale, num_groups, interpret):
+    global KERNEL_TRACE_COUNT
+    KERNEL_TRACE_COUNT += 1
+    b, h, w, c = x.shape
+    g = min(num_groups, c)
+    hw, cg = h * w, c // g
+    oh = _onehot(c, g)
+    x_spec, vec_spec, oh_spec, oht_spec, stat_spec = _block_specs(b, h, w, c, g)
+    partial_spec = pl.BlockSpec((1, c), lambda i: (i, 0))
+    dx, ds, db = pl.pallas_call(
+        functools.partial(_bwd_kernel, hw=hw, cg=cg),
+        grid=(b,),
+        in_specs=[x_spec, x_spec, stat_spec, stat_spec, vec_spec, oh_spec,
+                  oht_spec],
+        out_specs=[x_spec, partial_spec, partial_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct((b, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, dy, mean, rstd, scale.reshape(1, c), oh, oh.T)
+    return dx, ds, db
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _gn(x, scale, bias, num_groups, eps, interpret):
+    y, _, _ = _fwd_pallas(x, scale, bias, num_groups, eps, interpret)
+    return y
+
+
+def _gn_fwd(x, scale, bias, num_groups, eps, interpret):
+    y, mean, rstd = _fwd_pallas(x, scale, bias, num_groups, eps, interpret)
+    return y, (x, mean, rstd, scale)
+
+
+def _gn_bwd(num_groups, eps, interpret, residuals, dy):
+    x, mean, rstd, scale = residuals
+    dx, ds, db = _bwd_pallas(
+        x, dy, mean, rstd, scale, num_groups, interpret
+    )
+    return dx, jnp.sum(ds, axis=0), jnp.sum(db, axis=0)
+
+
+_gn.defvjp(_gn_fwd, _gn_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Partitioner-visible route (custom_partitioning), mirroring
+# ops/flash_attention.py: under a mesh an unwrapped pallas_call would be
+# replicated by GSPMD; the Shardy rule (batch shardable, everything else
+# need-replication) lets the partitioner run the kernel per batch shard.
+# Group statistics are returned rank-4 ([B, G, 1, 1]) so every result can
+# reuse x's sharding verbatim — the callbacks then work on the opaque
+# GSPMDShardings a partial-manual region hands them.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _cp_fwd_call(num_groups, eps, interpret):
+    from jax.experimental.custom_partitioning import (
+        SdyShardingRule,
+        custom_partitioning,
+    )
+
+    def impl(x, scale, bias):
+        y, mean, rstd = _fwd_pallas(x, scale, bias, num_groups, eps,
+                                    interpret)
+        return y, mean[..., None, None], rstd[..., None, None]
+
+    fn = custom_partitioning(impl)
+
+    # Stats come back rank-4 [B, G, 1, 1] precisely so all three results
+    # can reuse x's sharding (only b is shardable under the rule).
+    infer, part = dispatch_lib.passthrough_callbacks(impl, 3)
+
+    bhwc = ("b", "h", "w", "c")
+    fn.def_partition(
+        infer_sharding_from_operands=infer,
+        partition=part,
+        sharding_rule=SdyShardingRule(
+            operand_mappings=(bhwc, ("c",), ("c",)),
+            result_mappings=(bhwc, ("b", "g", "o1", "o2"),
+                             ("b", "g2", "o3", "o4")),
+            need_replication_factors=(
+                "h", "w", "c", "g", "o1", "o2", "g2", "o3", "o4"
+            ),
+        ),
+    )
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _cp_bwd_call(num_groups, interpret):
+    from jax.experimental.custom_partitioning import (
+        SdyShardingRule,
+        custom_partitioning,
+    )
+
+    def impl(x, dy, mean4, rstd4, scale):
+        dx, ds, db = _bwd_pallas(
+            x, dy, mean4[..., 0, 0], rstd4[..., 0, 0], scale, num_groups,
+            interpret,
+        )
+        return dx, ds[:, None, None, :], db[:, None, None, :]
+
+    fn = custom_partitioning(impl)
+
+    # dx and the [B, 1, 1, C] dscale/dbias partials all reuse x's sharding.
+    infer, part = dispatch_lib.passthrough_callbacks(impl, 3)
+
+    bhwc = ("b", "h", "w", "c")
+    fn.def_partition(
+        infer_sharding_from_operands=infer,
+        partition=part,
+        sharding_rule=SdyShardingRule(
+            operand_mappings=(bhwc, bhwc, ("b", "g", "o1", "o2"),
+                              ("b", "g2", "o3", "o4"), ("c",)),
+            result_mappings=(bhwc, ("b", "o5", "o6", "c"),
+                             ("b", "o7", "o8", "c")),
+            need_replication_factors=(
+                "h", "w", "c", "g", "o1", "o2", "g2", "o3", "o4",
+                "o5", "o6", "o7", "o8",
+            ),
+        ),
+    )
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _gn_partitioned(num_groups, eps, interpret):
+    fwd_call = _cp_fwd_call(num_groups, eps, interpret)
+    bwd_call = _cp_bwd_call(num_groups, interpret)
+
+    @jax.custom_vjp
+    def f(x, scale, bias):
+        y, _, _ = fwd_call(x, scale, bias)
+        return y
+
+    def f_fwd(x, scale, bias):
+        y, mean4, rstd4 = fwd_call(x, scale, bias)
+        return y, (x, mean4, rstd4, scale)
+
+    def f_bwd(res, dy):
+        x, mean4, rstd4, scale = res
+        dx, ds4, db4 = bwd_call(x, dy, mean4, rstd4, scale)
+        # Cross-batch reduction OUTSIDE the cp boundary: GSPMD turns the
+        # sharded [B, 1, 1, C] sum into the right collective itself.
+        return dx, jnp.sum(ds4, axis=(0, 1, 2)), jnp.sum(db4, axis=(0, 1, 2))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def kernel_eligible(x, num_groups) -> bool:
+    """Shapes the kernel handles: 4-D NHWC, groups divide channels, the
+    [HW, C] view sublane-aligned, and a per-sample block that fits VMEM
+    (f32 activation + working copies, conservatively 4 MiB)."""
+    if x.ndim != 4:
+        return False
+    b, h, w, c = x.shape
+    g = min(num_groups, c)
+    if c % g:
+        return False
+    if (h * w) % 8:
+        return False
+    return h * w * c * 4 <= 4 * 1024 * 1024
+
+
+def group_norm(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    *,
+    num_groups: int = 32,
+    eps: float = 1e-5,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+    partitioned: Optional[bool] = None,
+) -> jnp.ndarray:
+    """GroupNorm over NHWC with affine params [C]; differentiable.
+
+    ``use_pallas=None`` auto-dispatches to the fused kernel on TPU when
+    :func:`kernel_eligible`; elsewhere (or on odd shapes) the jnp
+    reference runs — identical algorithm, so dispatch never changes
+    numerics beyond kernel-vs-fusion float ordering.
+
+    ``partitioned=None`` routes through custom_partitioning whenever the
+    framework's global mesh is installed (an unwrapped pallas_call would
+    be replicated by GSPMD there); ``False``/``True`` force the direct /
+    partitioner-visible path.
+    """
+    if not interpret and dispatch_lib.force_interpret():
+        interpret = True
+    if use_pallas is None:
+        use_pallas = (
+            jax.default_backend() == "tpu" and kernel_eligible(x, num_groups)
+        )
+    if interpret and kernel_eligible(x, num_groups):
+        use_pallas = True
+    if not use_pallas or not kernel_eligible(x, num_groups):
+        return _reference(x, scale, bias, num_groups, eps)
+    if partitioned is None:
+        from cloud_tpu.parallel import mesh as mesh_lib
+
+        partitioned = mesh_lib.get_global_mesh() is not None
+    scale32 = scale.astype(jnp.float32)
+    bias32 = bias.astype(jnp.float32)
+    if partitioned:
+        g = min(num_groups, x.shape[-1])
+        return _gn_partitioned(g, eps, interpret)(x, scale32, bias32)
+    return _gn(x, scale32, bias32, num_groups, eps, interpret)
