@@ -157,9 +157,9 @@ def sharded_attention(q, k, v, *, causal: bool,
     - otherwise: direct dispatch (kernel on TPU, jnp reference elsewhere)
 
     ``mask`` is a [B, T_k] valid-token padding mask; the flash kernels
-    apply it key-side (flash_attention docstring).  Masked ring attention
-    falls back to GSPMD-partitioned reference ops (the ring fold carries
-    no mask plumbing yet).
+    apply it key-side (flash_attention docstring).  With ``sp`` > 1 the
+    mask shards over the sequence axis and rides the ring with its K/V
+    block (zig-zag stays causal/unmasked — pretraining layout).
     """
     from functools import partial
 
@@ -176,9 +176,18 @@ def sharded_attention(q, k, v, *, causal: bool,
     if sharding_lib.manual_context_mesh() is not None:
         return ops.flash_attention(q, k, v, causal=causal, mask=mask,
                                    partitioned=True)
-    if sp_size > 1 and mask is None:
+    if sp_size > 1:
         from cloud_tpu.parallel.ring_attention import ring_attention_balanced
 
+        if zigzag and causal and mask is not None:
+            # The balanced ring carries no mask plumbing, and the
+            # positional fallback would mask by ARRAY index on
+            # zig-zag-permuted data — silently wrong.  Refuse instead.
+            raise ValueError(
+                "padding masks are unsupported with zigzag_sp (the "
+                "zig-zag layout is for unpadded pretraining batches); "
+                "disable config.zigzag_sp for masked data"
+            )
         batch_axes = rules.assignment("batch")
         heads_axes = rules.assignment("heads")
         spec = PartitionSpec(batch_axes, mesh_lib.AXIS_SP, heads_axes, None)
@@ -186,27 +195,38 @@ def sharded_attention(q, k, v, *, causal: bool,
             # Caller guarantees the sequence is in zig-zag layout
             # (zigzag_indices) — per-hop-balanced causal ring.
             ring_fn = partial(ring_attention_balanced, axis=mesh_lib.AXIS_SP)
+            args, in_specs = (q, k, v), (spec, spec, spec)
+        elif mask is not None:
+            # The [B, T] padding mask shards over sp like k's sequence dim
+            # and rides the ring with its block (ring_attention docstring).
+            def ring_fn(q_, k_, v_, m_):
+                return ring_attention(
+                    q_, k_, v_, axis=mesh_lib.AXIS_SP, causal=causal,
+                    mask=m_,
+                )
+
+            args = (q, k, v, mask)
+            in_specs = (spec, spec, spec,
+                        PartitionSpec(batch_axes, mesh_lib.AXIS_SP))
         else:
             ring_fn = partial(
                 ring_attention, axis=mesh_lib.AXIS_SP, causal=causal
             )
+            args, in_specs = (q, k, v), (spec, spec, spec)
         return jax.shard_map(
             ring_fn,
             mesh=mesh,
-            in_specs=(spec, spec, spec),
+            in_specs=in_specs,
             out_specs=spec,
             # The online-softmax accumulators start replicated and become
             # axis-varying inside the fori_loop; skip VMA carry checking.
             check_vma=False,
-        )(q, k, v)
+        )(*args)
     if mesh is not None and sp_size == 1:
         return ops.flash_attention(q, k, v, causal=causal, mask=mask,
                                    partitioned=True)
-    # sp>1 with a mask (no ring plumbing), or no mesh at all.
-    return ops.flash_attention(
-        q, k, v, causal=causal, mask=mask,
-        use_pallas=False if (mesh is not None and sp_size > 1) else None,
-    )
+    # No mesh at all: direct dispatch.
+    return ops.flash_attention(q, k, v, causal=causal, mask=mask)
 
 
 def attention_block_axes():

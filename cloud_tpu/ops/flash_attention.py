@@ -70,13 +70,6 @@ SCORE_BYTES_FOR_KERNEL = int(
 KERNEL_TRACE_COUNT = 0
 
 
-def _force_interpret() -> bool:
-    """See ops/dispatch.py — the shared env contract."""
-    from cloud_tpu.ops.dispatch import force_interpret
-
-    return force_interpret()
-
-
 # ---------------------------------------------------------------------------
 # Reference implementation (ground truth + non-TPU fallback)
 # ---------------------------------------------------------------------------
@@ -696,7 +689,7 @@ def _dispatch(q, k, v, *, causal, mask, block_q, block_k, use_pallas,
               interpret, with_lse, partitioned=False):
     """Shared fit/dispatch/transpose wrapper for both public entry points
     (kept in ONE place so mask/fit rules can't drift between them)."""
-    if not interpret and _force_interpret():
+    if not interpret and dispatch_lib.force_interpret():
         interpret = True
     fitted_q = _fit_block(q.shape[1], block_q)
     fitted_k = _fit_block(k.shape[1], block_k)

@@ -52,6 +52,7 @@ def ring_attention(
     axis: str,
     *,
     causal: bool = True,
+    mask: Optional[jnp.ndarray] = None,
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -63,6 +64,12 @@ def ring_attention(
         ``[i*T_local, (i+1)*T_local)``).
       axis: mesh axis name carrying the sequence shards.
       causal: apply a causal mask in *global* positions.
+      mask: optional [B, T_local] KEY-side padding mask (nonzero = attend),
+        the local shard of the global [B, T] mask, sharded like k's
+        sequence dim.  It rides the ring with its K/V block, so every
+        query block sees every key's mask bit exactly once.  Query-side
+        semantics match the kernels: fully-masked query rows produce
+        uniform garbage the caller's loss mask must drop.
       use_pallas: per-block kernel dispatch — None auto-detects (TPU +
         tileable local block), True forces the kernel, False forces jnp.
       interpret: run the kernels in the Pallas interpreter (CPU tests).
@@ -74,14 +81,14 @@ def ring_attention(
     my_idx = lax.axis_index(axis)
     b, t, h, d = q.shape
 
-    def block_attention(k_blk, v_blk, block_causal: bool):
+    def block_attention(k_blk, v_blk, m_blk, block_causal: bool):
         out, lse = flash_attention_with_lse(
-            q, k_blk, v_blk, causal=block_causal,
+            q, k_blk, v_blk, causal=block_causal, mask=m_blk,
             use_pallas=use_pallas, interpret=interpret,
         )
         return out.astype(jnp.float32), lse  # [B,T,H,D] f32, [B,H,T] f32
 
-    def fold_block(carry, k_blk, v_blk, src_idx):
+    def fold_block(carry, k_blk, v_blk, m_blk, src_idx):
         o_acc, lse_acc = carry
         if causal:
             # Exact block-level causality (equal block sizes): past blocks
@@ -99,31 +106,42 @@ def ring_attention(
                 skip,
                 lambda: lax.cond(
                     src_idx == my_idx,
-                    lambda: block_attention(k_blk, v_blk, True),
-                    lambda: block_attention(k_blk, v_blk, False),
+                    lambda: block_attention(k_blk, v_blk, m_blk, True),
+                    lambda: block_attention(k_blk, v_blk, m_blk, False),
                 ),
             )
         else:
-            out_blk, lse_blk = block_attention(k_blk, v_blk, False)
+            out_blk, lse_blk = block_attention(k_blk, v_blk, m_blk, False)
         return _merge_partials(o_acc, lse_acc, out_blk, lse_blk)
 
     def body(i, carry):
-        o_acc, lse_acc, k_cur, v_cur = carry
+        o_acc, lse_acc, k_cur, v_cur, m_cur = carry
         # Block currently held originated at rank (my_idx - i) mod n.
         src_idx = jax.lax.rem(my_idx - i + n, n)
-        o_acc, lse_acc = fold_block((o_acc, lse_acc), k_cur, v_cur, src_idx)
+        o_acc, lse_acc = fold_block(
+            (o_acc, lse_acc), k_cur, v_cur,
+            None if mask is None else m_cur, src_idx,
+        )
         k_nxt = _rotate(k_cur, axis, n)
         v_nxt = _rotate(v_cur, axis, n)
-        return o_acc, lse_acc, k_nxt, v_nxt
+        m_nxt = m_cur if mask is None else _rotate(m_cur, axis, n)
+        return o_acc, lse_acc, k_nxt, v_nxt, m_nxt
 
     o0 = jnp.zeros((b, t, h, d), jnp.float32)
     lse0 = jnp.full((b, h, t), NEG_INF, jnp.float32)
+    # The mask slot carries a dummy scalar when unused so the fori_loop
+    # carry structure stays static.
+    m0 = jnp.zeros((), jnp.int32) if mask is None else mask.astype(jnp.int32)
     # Loop runs n-1 hops (each fold + rotate); the final block is folded
     # outside so no dead K/V rotation ships on the last hop (a fori_loop
     # body is compiled once — XLA cannot trim it per-iteration).
-    o, lse, k_last, v_last = lax.fori_loop(0, n - 1, body, (o0, lse0, k, v))
+    o, lse, k_last, v_last, m_last = lax.fori_loop(
+        0, n - 1, body, (o0, lse0, k, v, m0)
+    )
     o, lse = fold_block(
-        (o, lse), k_last, v_last, jax.lax.rem(my_idx - (n - 1) + n, n)
+        (o, lse), k_last, v_last,
+        None if mask is None else m_last,
+        jax.lax.rem(my_idx - (n - 1) + n, n),
     )
     return o.astype(q.dtype)
 
