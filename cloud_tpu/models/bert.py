@@ -32,6 +32,11 @@ class BertConfig:
     max_seq_len: int = 512
     num_classes: int = 2  # sequence classification head
     dtype: Any = jnp.bfloat16
+    #: Sublayer-output dropout (BERT convention: attention out-proj, MLP
+    #: out, embeddings, pooled head — each before its residual/LN or
+    #: classifier).  Active only when a ``dropout_rng`` is passed (the
+    #: training path); eval and generation stay deterministic.
+    dropout_rate: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -105,9 +110,21 @@ def encode(
     attention_mask: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     rules: ShardingRules = DEFAULT_RULES,
+    dropout_rng: Optional[jax.Array] = None,
 ):
-    """tokens [B, T] -> contextual embeddings [B, T, D]."""
+    """tokens [B, T] -> contextual embeddings [B, T, D].
+
+    ``dropout_rng`` switches on ``cfg.dropout_rate`` dropout (training);
+    None (the default) is the deterministic eval path.
+    """
     b, t = tokens.shape
+    rate = cfg.dropout_rate if dropout_rng is not None else 0.0
+    embed_rng = layer_rngs = None
+    if rate > 0.0:
+        embed_rng, stack_rng = jax.random.split(dropout_rng)
+        # Per-layer keys ride the scan as xs, aligned with the stacked
+        # params (fold_in can't run inside scan over a traced index).
+        layer_rngs = jax.random.split(stack_rng, cfg.num_layers)
     x = layers.embedding_apply(params["tok"], tokens, dtype=cfg.dtype,
                                rules=rules)
     # Positions are always arange: a static slice of the table broadcast
@@ -117,11 +134,18 @@ def encode(
         x = x + layers.embedding_apply(params["seg"], segment_ids,
                                        dtype=cfg.dtype, rules=rules)
     x = layers.layernorm_apply(params["ln_embed"], x)
+    x = layers.dropout(embed_rng, x, rate)
     x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules)
 
     h, hd = cfg.num_heads, cfg.head_dim
 
-    def layer_body(x, lp):
+    def layer_body(x, layer_slice):
+        if rate > 0.0:
+            lp, lrng = layer_slice
+            att_rng, mlp_rng = jax.random.split(lrng)
+        else:
+            lp, att_rng, mlp_rng = layer_slice, None, None
+
         def proj(p):
             y = layers.dense_apply(p, x).reshape(b, t, h, hd)
             return shard_constraint(y, "batch", "seq", "heads", None,
@@ -134,15 +158,18 @@ def encode(
             mask=attention_mask, causal=False, rules=rules,
         )
         att_out = layers.dense_apply(lp["att"]["out"], attended.reshape(b, t, -1))
+        att_out = layers.dropout(att_rng, att_out, rate)
         x = layers.layernorm_apply(lp["ln1"], x + att_out)
         mlp = layers.dense_apply(
             lp["wo"], jax.nn.gelu(layers.dense_apply(lp["wi"], x))
         )
+        mlp = layers.dropout(mlp_rng, mlp, rate)
         x = layers.layernorm_apply(lp["ln2"], x + mlp)
         x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules)
         return x, None
 
-    x, _ = jax.lax.scan(layer_body, x, params["layers"])
+    xs = (params["layers"], layer_rngs) if rate > 0.0 else params["layers"]
+    x, _ = jax.lax.scan(layer_body, x, xs)
     return x
 
 
@@ -151,21 +178,29 @@ def apply(
     attention_mask: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     rules: ShardingRules = DEFAULT_RULES,
+    dropout_rng: Optional[jax.Array] = None,
 ):
     """Sequence classification: tokens [B, T] -> logits [B, num_classes]."""
+    head_rng = None
+    if dropout_rng is not None and cfg.dropout_rate > 0.0:
+        dropout_rng, head_rng = jax.random.split(dropout_rng)
     x = encode(params, tokens, cfg, attention_mask=attention_mask,
-               segment_ids=segment_ids, rules=rules)
+               segment_ids=segment_ids, rules=rules,
+               dropout_rng=dropout_rng)
     pooled = jnp.tanh(layers.dense_apply(params["pooler"], x[:, 0]))
+    pooled = layers.dropout(head_rng, pooled, cfg.dropout_rate)
     return layers.dense_apply(params["classifier"], pooled, dtype=jnp.float32)
 
 
 def loss_fn(params, batch: Dict[str, jnp.ndarray],
             cfg: BertConfig = BERT_BASE, *,
-            rules: ShardingRules = DEFAULT_RULES) -> Tuple[jnp.ndarray, Dict]:
+            rules: ShardingRules = DEFAULT_RULES,
+            rng: Optional[jax.Array] = None) -> Tuple[jnp.ndarray, Dict]:
     logits = apply(
         params, batch["tokens"], cfg,
         attention_mask=batch.get("attention_mask"),
         segment_ids=batch.get("segment_ids"), rules=rules,
+        dropout_rng=rng,
     )
     labels = batch["label"]
     log_probs = jax.nn.log_softmax(logits)

@@ -63,8 +63,11 @@ def sample_logits(rng, logits, sample: SampleConfig):
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cumulative = jnp.cumsum(probs, axis=-1)
         # Keep the smallest prefix with mass >= top_p (the cutoff token
-        # itself stays includable, hence the shift-by-one).
+        # itself stays includable, hence the shift-by-one).  The top
+        # token always survives — at top_p=0.0 the strict < would
+        # otherwise keep nothing and sample from all -inf garbage.
         keep = cumulative - probs < sample.top_p
+        keep = keep.at[..., 0].set(True)
         threshold = jnp.min(
             jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
         )

@@ -121,6 +121,16 @@ def rotary_embedding(x, positions, *, base: float = 10000.0):
     return out.astype(x.dtype)
 
 
+def dropout(rng, x, rate: float):
+    """Inverted dropout: identity when ``rng`` is None or ``rate`` == 0
+    (the eval / deterministic path needs no branching at call sites)."""
+    if rng is None or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
 def causal_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
                      causal: bool = True):
     """Reference (non-ring, non-Pallas) attention: [B, T, H, D] layout.
@@ -179,10 +189,12 @@ def sharded_attention(q, k, v, *, causal: bool,
     if sp_size > 1:
         from cloud_tpu.parallel.ring_attention import ring_attention_balanced
 
-        if zigzag and causal and mask is not None:
-            # The balanced ring carries no mask plumbing, and the
-            # positional fallback would mask by ARRAY index on
-            # zig-zag-permuted data — silently wrong.  Refuse instead.
+        if zigzag and mask is not None:
+            # Neither ring variant carries mask plumbing for permuted
+            # layouts: a natural-order [B, T] mask applied to
+            # zig-zag-permuted K slots masks the WRONG tokens.  Refuse
+            # for every zigzag call (causal or not) instead of silently
+            # corrupting.
             raise ValueError(
                 "padding masks are unsupported with zigzag_sp (the "
                 "zig-zag layout is for unpadded pretraining batches); "

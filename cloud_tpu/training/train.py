@@ -26,6 +26,9 @@ class TrainState(struct.PyTreeNode):
     step: jnp.ndarray
     params: Any
     opt_state: Any
+    #: PRNG key threading through stochastic train steps (dropout); None
+    #: for deterministic training.  Each step consumes a fresh split.
+    rng: Any = None
 
 
 def param_shardings(
@@ -75,6 +78,7 @@ def create_sharded_state(
     mesh: Optional[Mesh],
     logical_axes=None,
     rules: ShardingRules = DEFAULT_RULES,
+    train_rng: Any = None,
 ) -> TrainState:
     """Initialize a TrainState with parameters born sharded.
 
@@ -97,7 +101,7 @@ def create_sharded_state(
                 opt_state, params, logical_axes, rules, mesh
             )
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                          opt_state=opt_state)
+                          opt_state=opt_state, rng=train_rng)
 
     if mesh is None:
         return build(rng)
@@ -112,16 +116,33 @@ def make_train_step(
     logical_axes=None,
     rules: ShardingRules = DEFAULT_RULES,
     mesh: Optional[Mesh] = None,
+    stochastic: bool = False,
 ):
     """Build ``step(state, batch) -> (state, metrics)``, jit-compiled.
 
     ``loss_fn(params, batch) -> (loss, metrics)``.  The returned step
     donates the input state (in-place buffer reuse on TPU — halves HBM
     traffic for the optimizer update).
+
+    ``stochastic=True`` threads the state's PRNG key through the loss:
+    ``loss_fn(params, batch, rng=...)`` gets a fresh split every step
+    (dropout et al.), and the state must have been created with a
+    ``train_rng`` (``create_sharded_state(..., train_rng=key)``).
     """
 
     def step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        next_rng = state.rng
+        if stochastic:
+            if state.rng is None:
+                raise ValueError(
+                    "stochastic=True needs a state built with train_rng"
+                )
+            next_rng, step_rng = jax.random.split(state.rng)
+            grad_fn = jax.value_and_grad(
+                partial(loss_fn, rng=step_rng), has_aux=True
+            )
+        else:
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (_, metrics), grads = grad_fn(state.params, batch)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
@@ -130,7 +151,8 @@ def make_train_step(
         if mesh is not None and logical_axes is not None:
             new_params = _constrain(new_params, logical_axes, rules, mesh)
         new_state = TrainState(
-            step=state.step + 1, params=new_params, opt_state=new_opt_state
+            step=state.step + 1, params=new_params,
+            opt_state=new_opt_state, rng=next_rng,
         )
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)

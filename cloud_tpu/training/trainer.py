@@ -216,6 +216,10 @@ class Trainer:
       mesh: parallelism mesh (None = single device).
       logical_axes: params-congruent pytree of logical axis tuples.
       rules: logical->mesh axis table.
+      stochastic: thread a PRNG key through every train step —
+        ``loss_fn(params, batch, rng=...)`` (dropout etc.).  Eval steps
+        stay deterministic (no rng passed).  ``init_state`` derives the
+        training key from its rng automatically.
     """
 
     def __init__(
@@ -227,6 +231,7 @@ class Trainer:
         mesh=None,
         logical_axes=None,
         rules: ShardingRules = DEFAULT_RULES,
+        stochastic: bool = False,
     ):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -234,19 +239,25 @@ class Trainer:
         self.mesh = mesh
         self.logical_axes = logical_axes
         self.rules = rules
+        self.stochastic = stochastic
         self.state: Optional[train_lib.TrainState] = None
         self.stop_training = False
         self._train_step = train_lib.make_train_step(
-            loss_fn, optimizer, logical_axes=logical_axes, rules=rules, mesh=mesh
+            loss_fn, optimizer, logical_axes=logical_axes, rules=rules,
+            mesh=mesh, stochastic=stochastic,
         )
         self._eval_step = train_lib.make_eval_step(loss_fn)
 
     def init_state(self, rng) -> train_lib.TrainState:
         if self.init_fn is None:
             raise ValueError("Trainer needs init_fn to create state")
+        train_rng = None
+        if self.stochastic:
+            rng, train_rng = jax.random.split(rng)
         self.state = train_lib.create_sharded_state(
             rng, self.init_fn, self.optimizer, self.mesh,
             logical_axes=self.logical_axes, rules=self.rules,
+            train_rng=train_rng,
         )
         return self.state
 

@@ -110,6 +110,21 @@ class TestSampling:
         )["tokens"]
         np.testing.assert_array_equal(np.asarray(topk1), np.asarray(greedy))
 
+    def test_top_p_zero_degenerates_to_greedy(self):
+        """top_p=0.0 must keep the top token (not filter everything to
+        -inf and sample garbage)."""
+        config, params, prompt, lens = self._setup()
+        top_p0 = generation.generate(
+            params, prompt, lens, config, max_new_tokens=5,
+            sample=generation.SampleConfig(temperature=1.3, top_p=0.0),
+            rng=jax.random.PRNGKey(5),
+        )["tokens"]
+        greedy = generation.generate(
+            params, prompt, lens, config, max_new_tokens=5,
+            sample=generation.SampleConfig(temperature=0.0),
+        )["tokens"]
+        np.testing.assert_array_equal(np.asarray(top_p0), np.asarray(greedy))
+
     def test_top_p_one_keeps_full_support_and_runs(self):
         config, params, prompt, lens = self._setup()
         out = generation.generate(

@@ -5,6 +5,7 @@ attention exactly, MoE/pp/ep configurations compile and run, Trainer
 callback protocol, checkpoint round-trip.
 """
 
+import dataclasses
 import functools
 
 import jax
@@ -434,6 +435,104 @@ class TestResnet:
         new_state, metrics = step(state, batch)
         assert int(new_state.step) == 1
         assert np.isfinite(metrics["loss"])
+
+
+class TestDropout:
+    def test_identity_when_off(self):
+        x = jnp.ones((4, 8))
+        np.testing.assert_array_equal(
+            np.asarray(layers.dropout(None, x, 0.5)), np.asarray(x)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(layers.dropout(jax.random.PRNGKey(0), x, 0.0)),
+            np.asarray(x),
+        )
+
+    def test_scales_and_zeroes(self):
+        x = jnp.ones((100, 100))
+        y = np.asarray(layers.dropout(jax.random.PRNGKey(0), x, 0.25))
+        assert set(np.unique(y)).issubset({0.0, np.float32(1 / 0.75)})
+        # Keep fraction near 0.75, and the expectation is preserved.
+        assert abs((y > 0).mean() - 0.75) < 0.02
+        assert abs(y.mean() - 1.0) < 0.02
+
+    def test_bert_dropout_stochastic_in_train_deterministic_in_eval(self):
+        cfg = dataclasses.replace(bert.TINY, dropout_rate=0.1)
+        params = bert.init(jax.random.PRNGKey(0), cfg)
+        batch = {
+            "tokens": jnp.asarray([[1, 2, 3, 4]] * 2, jnp.int32),
+            "label": jnp.asarray([0, 1], jnp.int32),
+        }
+        l1, _ = bert.loss_fn(params, batch, cfg, rng=jax.random.PRNGKey(1))
+        l2, _ = bert.loss_fn(params, batch, cfg, rng=jax.random.PRNGKey(2))
+        l_eval1, _ = bert.loss_fn(params, batch, cfg)
+        l_eval2, _ = bert.loss_fn(params, batch, cfg)
+        assert float(l1) != float(l2)  # different masks, different loss
+        assert float(l_eval1) == float(l_eval2)  # no rng -> deterministic
+
+    def test_stochastic_train_step_threads_rng(self):
+        cfg = dataclasses.replace(bert.TINY, dropout_rate=0.1)
+        opt = optax.adam(1e-3)
+        state = train_lib.create_sharded_state(
+            jax.random.PRNGKey(0),
+            functools.partial(bert.init, cfg=cfg),
+            opt, mesh=None, train_rng=jax.random.PRNGKey(7),
+        )
+        step = train_lib.make_train_step(
+            functools.partial(bert.loss_fn, cfg=cfg), opt, stochastic=True
+        )
+        batch = {
+            "tokens": jnp.asarray([[1, 2, 3, 4]] * 4, jnp.int32),
+            "label": jnp.asarray([0, 1, 0, 1], jnp.int32),
+        }
+        rng_before = np.asarray(state.rng).copy()  # step donates the state
+        s1, m1 = step(state, batch)
+        assert not np.array_equal(np.asarray(s1.rng), rng_before)
+        s2, m2 = step(s1, batch)
+        # Same batch, fresh dropout mask -> different loss values.
+        assert float(m1["loss"]) != float(m2["loss"])
+
+    def test_trainer_fit_with_dropout(self):
+        cfg = dataclasses.replace(bert.TINY, dropout_rate=0.1)
+        rng = np.random.default_rng(0)
+        n = 32
+        labels = rng.integers(0, 2, n)
+        tokens = np.where(
+            labels[:, None] == 1,
+            rng.integers(256, 512, (n, 8)),
+            rng.integers(1, 256, (n, 8)),
+        ).astype(np.int32)
+        tr = Trainer(
+            functools.partial(bert.loss_fn, cfg=cfg),
+            optax.adam(1e-3),
+            init_fn=functools.partial(bert.init, cfg=cfg),
+            stochastic=True,
+        )
+        tr.init_state(jax.random.PRNGKey(0))
+        assert tr.state.rng is not None
+        ds = data.ArrayDataset(
+            {"tokens": tokens, "label": labels}, batch_size=16
+        )
+        hist = tr.fit(ds, epochs=3)
+        losses = hist.history["loss"]
+        assert losses[-1] < losses[0]
+
+    def test_stochastic_without_rng_raises(self):
+        opt = optax.adam(1e-3)
+        state = train_lib.create_sharded_state(
+            jax.random.PRNGKey(0),
+            functools.partial(bert.init, cfg=bert.TINY),
+            opt, mesh=None,
+        )
+        step = train_lib.make_train_step(
+            functools.partial(bert.loss_fn, cfg=bert.TINY), opt,
+            stochastic=True,
+        )
+        with pytest.raises(ValueError, match="train_rng"):
+            step(state, {
+                "tokens": jnp.zeros((2, 4), jnp.int32),
+                "label": jnp.zeros((2,), jnp.int32),
+            })
 
 
 class TestBert:
