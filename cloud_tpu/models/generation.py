@@ -281,12 +281,21 @@ def generate(
         emitted = jnp.where(post_eos, jnp.int32(sample.pad_id), token)
         return (cache_k, cache_v, cur_len, next_tok, done, rng), emitted
 
+    # N-1 scan steps: step i consumes carried token i and samples token
+    # i+1, so the last carried token needs no forward pass of its own —
+    # it is emitted (and counted) directly from the final carry.  (With
+    # max_new_tokens=1 the scan body never runs; tok0 came from prefill.)
     carry0 = (cache["k"], cache["v"], prompt_lens, tok0,
               jnp.zeros((b,), bool), rng)
-    (_, _, final_len, _, _, _), emitted = jax.lax.scan(
-        step, carry0, None, length=max_new_tokens
+    (_, _, cur_len, last_tok, last_post, _), emitted = jax.lax.scan(
+        step, carry0, None, length=max_new_tokens - 1
     )
-    tokens = emitted.T  # [B, max_new_tokens]
+    final_emit = jnp.where(last_post, jnp.int32(sample.pad_id), last_tok)
+    final_len = cur_len + jnp.where(last_post, 0, 1)
+    if max_new_tokens > 1:
+        tokens = jnp.concatenate([emitted.T, final_emit[:, None]], axis=1)
+    else:
+        tokens = final_emit[:, None]
 
     # Stitch prompt + generation at each row's true offset.  ``tokens`` is
     # already pad-masked past the eos, so the scatter needs no validity

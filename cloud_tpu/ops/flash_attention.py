@@ -685,6 +685,40 @@ def _flash_partitioned(causal, block_q, block_k, interpret, use_mask):
     return f
 
 
+_partitioned_fallback_warned = False
+
+
+def _warn_partitioned_fallback(q, k, mask):
+    """One-time warning when a ``partitioned=True`` caller (the pipeline
+    region / mesh-auto path, which EXPECTS the O(T) kernel) falls back to
+    the O(T^2) reference at a size where that hurts — ineligible shapes
+    (unalignable T, head_dim > 256, mask shape mismatch) reach here with
+    no other signal."""
+    global _partitioned_fallback_warned
+    if _partitioned_fallback_warned:
+        return
+    score_bytes = (
+        q.shape[0] * q.shape[2] * q.shape[1] * k.shape[1] * 4
+        if q.ndim == 4 else 0
+    )
+    if (q.shape[1] < MIN_SEQ_LEN_FOR_KERNEL
+            and score_bytes < SCORE_BYTES_FOR_KERNEL):
+        return  # below both thresholds XLA's fused path is the right call
+    if jax.default_backend() != "tpu" and not dispatch_lib.force_interpret():
+        return  # off-TPU the reference is the only option — not a fallback
+    _partitioned_fallback_warned = True
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "partitioned attention dispatch at q shape %s fell back to the "
+        "O(T^2) jnp reference (shape not kernel-eligible: unalignable T, "
+        "head_dim > 256, or mask shape mismatch). Expect per-layer score "
+        "residual memory; pad T to an 8-aligned size to restore the "
+        "flash kernel.",
+        tuple(q.shape),
+    )
+
+
 def _dispatch(q, k, v, *, causal, mask, block_q, block_k, use_pallas,
               interpret, with_lse, partitioned=False):
     """Shared fit/dispatch/transpose wrapper for both public entry points
@@ -707,6 +741,8 @@ def _dispatch(q, k, v, *, causal, mask, block_q, block_k, use_pallas,
         # unalignable T) must still fall through to the reference.
         use_pallas = True
     if not use_pallas or not mask_ok:
+        if partitioned:
+            _warn_partitioned_fallback(q, k, mask)
         if with_lse:
             return _reference_with_lse(q, k, v, causal=causal, mask=mask)
         return _reference(q, k, v, causal=causal, mask=mask)

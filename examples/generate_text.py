@@ -1,0 +1,88 @@
+"""Train a tiny CloudLM, then sample from it with the KV-cache decoder.
+
+End-to-end inference flow: fit a character-level model on a toy corpus,
+then generate continuations with ``cloud_tpu.models.generation`` —
+greedy and nucleus sampling, ragged prompt lengths, eos stopping.  The
+whole decode is one compiled ``lax.scan`` program.
+
+Run locally on the virtual CPU rig (no TPU needed):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/generate_text.py
+
+Under a mesh the same call shards batch over dp/fsdp and heads over tp
+(see README "Text generation").
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+) * 40
+
+
+def main():
+    from cloud_tpu.models import generation, transformer
+    from cloud_tpu.training import Trainer, data
+
+    vocab = 128  # raw ascii
+    config = transformer.TINY.scaled(vocab_size=vocab, max_seq_len=64)
+    seq_len = 32
+
+    # Character-level windows over the corpus.
+    codes = np.frombuffer(CORPUS.encode(), np.uint8).astype(np.int32)
+    starts = np.arange(0, len(codes) - seq_len - 1, 7)
+    tokens = np.stack([codes[s:s + seq_len] for s in starts])
+
+    trainer = Trainer(
+        functools.partial(transformer.loss_fn, config=config),
+        optax.adamw(3e-3),
+        init_fn=functools.partial(transformer.init, config=config),
+    )
+    trainer.init_state(jax.random.PRNGKey(0))
+    ds = data.ArrayDataset({"tokens": tokens}, batch_size=32, shuffle=True)
+    hist = trainer.fit(ds, epochs=3)
+    losses = hist.history["loss"]
+    print(f"train loss: {losses[0]:.3f} -> {losses[-1]:.3f}")
+    assert losses[-1] < losses[0]
+
+    # Ragged prompts, batched generation.
+    prompts = ["the quick brown ", "pack my "]
+    t_prompt = max(len(p) for p in prompts)
+    prompt_tokens = np.zeros((len(prompts), t_prompt), np.int32)
+    prompt_lens = np.asarray([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        prompt_tokens[i, : len(p)] = np.frombuffer(p.encode(), np.uint8)
+
+    for name, sample in [
+        ("greedy", generation.SampleConfig(temperature=0.0)),
+        ("nucleus", generation.SampleConfig(temperature=0.8, top_p=0.9)),
+    ]:
+        out = generation.generate(
+            trainer.state.params,
+            jnp.asarray(prompt_tokens),
+            jnp.asarray(prompt_lens),
+            config,
+            max_new_tokens=24,
+            sample=sample,
+            rng=jax.random.PRNGKey(1),
+        )
+        for i, p in enumerate(prompts):
+            n_real = int(prompt_lens[i]) + 24
+            text = bytes(
+                int(c) for c in np.asarray(out["sequences"])[i][:n_real]
+            ).decode(errors="replace")
+            print(f"{name:8s} | {text!r}")
+    return trainer
+
+
+if __name__ == "__main__":
+    main()

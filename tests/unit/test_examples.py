@@ -38,6 +38,12 @@ class TestGoldenWorkloads:
         )
         mod.main()  # asserts loss improvement internally (sp=4 mesh)
 
+    def test_generate_text_example(self):
+        mod = load_module(
+            os.path.join(EXAMPLES, "generate_text.py"), "ex_generate"
+        )
+        mod.main()  # trains (asserted internally) + samples both modes
+
     def test_mnist_fit(self, monkeypatch, tmp_path):
         monkeypatch.setenv("MNIST_EXAMPLE_EPOCHS", "2")
         monkeypatch.setenv("MNIST_EXAMPLE_STEPS", "4")

@@ -55,6 +55,25 @@ class TestGreedyEquivalence:
         want = _greedy_reference(params, prompt, lens, config, n_new)
         np.testing.assert_array_equal(np.asarray(got["tokens"]), want)
 
+    def test_single_token_generation(self):
+        """max_new_tokens=1: the decode scan never runs; the one token
+        comes straight from prefill and matches the oracle."""
+        config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(1, 255, (2, 6)).astype(np.int32)
+        lens = np.asarray([4, 6], np.int32)
+        got = generation.generate(
+            params, jnp.asarray(prompt), jnp.asarray(lens), config,
+            max_new_tokens=1,
+            sample=generation.SampleConfig(temperature=0.0),
+        )
+        want = _greedy_reference(params, prompt, lens, config, 1)
+        np.testing.assert_array_equal(np.asarray(got["tokens"]), want)
+        np.testing.assert_array_equal(
+            np.asarray(got["num_generated"]), [1, 1]
+        )
+
     def test_sequences_stitched_at_true_offsets(self):
         config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
         params = transformer.init(jax.random.PRNGKey(0), config)
