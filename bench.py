@@ -291,8 +291,56 @@ def _check_flash_attention(extras):
     extras["flash_attention_ok"] = bool(ok)
 
 
+def _check_group_norm(extras):
+    """Compile the fused GroupNorm kernel (fwd+bwd) on the device BEFORE
+    the ResNet measurement depends on it.  On failure the kernel is
+    disabled via CLOUD_TPU_GN_KERNEL=0 so ResNet still measures on the
+    jnp path; the extras record the degradation."""
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.ops import group_norm
+
+    if jax.default_backend() != "tpu":
+        extras["group_norm_kernel_ok"] = None
+        return
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    x = jax.random.normal(k1, (4, 8, 8, 128), jnp.bfloat16) * 2.0 + 5.0
+    s = jax.random.normal(k2, (128,), jnp.float32) * 0.2 + 1.0
+    b = jnp.zeros((128,), jnp.float32)
+
+    def loss(x, s, b, use_pallas):
+        y = group_norm(x, s, b, num_groups=32, use_pallas=use_pallas,
+                       partitioned=False)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    got = jax.jit(jax.value_and_grad(lambda *a: loss(*a, True),
+                                     argnums=(0, 1, 2)))(x, s, b)
+    want = jax.jit(jax.value_and_grad(lambda *a: loss(*a, False),
+                                      argnums=(0, 1, 2)))(x, s, b)
+
+    def close(a, c):
+        a = jnp.asarray(a, jnp.float32)
+        c = jnp.asarray(c, jnp.float32)
+        denom = jnp.maximum(jnp.max(jnp.abs(c)), 1e-6)
+        return float(jnp.max(jnp.abs(a - c)) / denom) < 3e-2
+
+    ok = close(got[0], want[0]) and all(
+        close(g, w) for g, w in zip(got[1], want[1])
+    )
+    if not ok:
+        raise AssertionError("group_norm kernel diverged from reference")
+    extras["group_norm_kernel_ok"] = True
+
+
 def _child_main() -> int:
     extras = {}
+    try:
+        _check_group_norm(extras)
+    except Exception as exc:  # noqa: BLE001 — degrade, don't die
+        os.environ["CLOUD_TPU_GN_KERNEL"] = "0"
+        extras["group_norm_kernel_ok"] = False
+        extras["group_norm_error"] = f"{type(exc).__name__}: {exc}"[:500]
     try:
         per_chip = _measure_resnet(extras)
     except Exception as exc:  # noqa: BLE001 — relayed to the parent as data

@@ -209,10 +209,22 @@ def generate(
         raise ValueError("non-greedy sampling needs an rng key")
     rng = jax.random.PRNGKey(0) if rng is None else rng
 
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     b, t_prompt = prompt_tokens.shape
+    prompt_lens = prompt_lens.astype(jnp.int32)
+    if max_new_tokens == 0:
+        cols = jnp.arange(t_prompt)[None, :]
+        return {
+            "tokens": jnp.zeros((b, 0), jnp.int32),
+            "sequences": jnp.where(
+                cols < prompt_lens[:, None], prompt_tokens.astype(jnp.int32),
+                jnp.int32(sample.pad_id),
+            ),
+            "num_generated": jnp.zeros((b,), jnp.int32),
+        }
     s = t_prompt + max_new_tokens
     cache = _init_cache(config, b, s, rules, mesh)
-    prompt_lens = prompt_lens.astype(jnp.int32)
 
     # --- prefill: one full forward over the prompt buffer ---
     positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))

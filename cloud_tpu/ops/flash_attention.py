@@ -685,6 +685,25 @@ def _flash_partitioned(causal, block_q, block_k, interpret, use_mask):
     return f
 
 
+def _score_bytes(q, k) -> int:
+    """Size of the would-be [B, H, Tq, Tk] f32 score tensor."""
+    return (
+        q.shape[0] * q.shape[2] * q.shape[1] * k.shape[1] * 4
+        if q.ndim == 4 else 0
+    )
+
+
+def _kernel_worthwhile(q, k) -> bool:
+    """The size half of the auto-dispatch predicate: is this shape big
+    enough that the kernel (not XLA's fused path) is the right call?
+    Shared by would_use_kernel and the partitioned-fallback warning so
+    the two can't drift."""
+    return (
+        q.shape[1] >= MIN_SEQ_LEN_FOR_KERNEL
+        or _score_bytes(q, k) >= SCORE_BYTES_FOR_KERNEL
+    )
+
+
 _partitioned_fallback_warned = False
 
 
@@ -697,12 +716,7 @@ def _warn_partitioned_fallback(q, k, mask):
     global _partitioned_fallback_warned
     if _partitioned_fallback_warned:
         return
-    score_bytes = (
-        q.shape[0] * q.shape[2] * q.shape[1] * k.shape[1] * 4
-        if q.ndim == 4 else 0
-    )
-    if (q.shape[1] < MIN_SEQ_LEN_FOR_KERNEL
-            and score_bytes < SCORE_BYTES_FOR_KERNEL):
+    if not _kernel_worthwhile(q, k):
         return  # below both thresholds XLA's fused path is the right call
     if jax.default_backend() != "tpu" and not dispatch_lib.force_interpret():
         return  # off-TPU the reference is the only option — not a fallback
@@ -723,6 +737,7 @@ def _dispatch(q, k, v, *, causal, mask, block_q, block_k, use_pallas,
               interpret, with_lse, partitioned=False):
     """Shared fit/dispatch/transpose wrapper for both public entry points
     (kept in ONE place so mask/fit rules can't drift between them)."""
+    explicit_opt_out = use_pallas is False
     if not interpret and dispatch_lib.force_interpret():
         interpret = True
     fitted_q = _fit_block(q.shape[1], block_q)
@@ -741,7 +756,9 @@ def _dispatch(q, k, v, *, causal, mask, block_q, block_k, use_pallas,
         # unalignable T) must still fall through to the reference.
         use_pallas = True
     if not use_pallas or not mask_ok:
-        if partitioned:
+        # Warn only when AUTO dispatch fell back — an explicit
+        # use_pallas=False caller opted out deliberately.
+        if partitioned and not explicit_opt_out:
             _warn_partitioned_fallback(q, k, mask)
         if with_lse:
             return _reference_with_lse(q, k, v, causal=causal, mask=mask)
@@ -841,17 +858,10 @@ def would_use_kernel(
         and mask.shape[0] == q.shape[0]
         and mask.shape[1] == k.shape[1]
     )
-    score_bytes = (
-        q.shape[0] * q.shape[2] * q.shape[1] * k.shape[1] * 4
-        if q.ndim == 4 else 0
-    )
     return (
         _jax.default_backend() == "tpu"
         and mask_ok
-        and (
-            q.shape[1] >= MIN_SEQ_LEN_FOR_KERNEL
-            or score_bytes >= SCORE_BYTES_FOR_KERNEL
-        )
+        and _kernel_worthwhile(q, k)
         and _kernel_eligible(q, k, fitted_q, fitted_k)
     )
 

@@ -345,6 +345,14 @@ def group_norm(
     be replicated by GSPMD there); ``False``/``True`` force the direct /
     partitioner-visible path.
     """
+    import os
+
+    if os.environ.get("CLOUD_TPU_GN_KERNEL", "") == "0":
+        # Operational kill switch (the bench flips it when the hardware
+        # gate fails, so a kernel regression degrades to the jnp path
+        # instead of sinking the measurement).  Checked before every other
+        # dispatch rule — including force-interpret — so it always wins.
+        return _reference(x, scale, bias, num_groups, eps)
     if not interpret and dispatch_lib.force_interpret():
         interpret = True
     if use_pallas is None:
