@@ -165,7 +165,7 @@ def _prefill_layer(layer_params, x, positions, prompt_mask, config, rules,
 
 def _final_logits(params, x, config):
     x = layers.rmsnorm_apply(params["ln_f"], x)
-    return layers.dense_apply(params["head"], x, dtype=jnp.float32)
+    return transformer.lm_logits(params, x, config)
 
 
 def generate(

@@ -51,6 +51,10 @@ class TransformerConfig:
     #: Microbatch count for pipeline parallelism (pp > 1); None -> pp size.
     #: Bubble fraction is (pp-1)/(M+pp-1), so raise this to amortize it.
     num_microbatches: Optional[int] = None
+    #: Tie the LM head to the token embedding (logits = x @ table^T):
+    #: halves the vocab-parameter footprint and is standard for smaller
+    #: LMs; init()/param_logical_axes() then carry no "head" entry.
+    tied_embeddings: bool = False
     #: With sp > 1: run causal attention as the load-balanced zig-zag ring
     #: (parallel/ring_attention.py).  apply() permutes tokens/positions
     #: into the zig-zag layout internally and loss_fn gathers next-token
@@ -100,11 +104,13 @@ def init(rng, config: TransformerConfig) -> Dict[str, Any]:
     layer_rngs = jax.random.split(r_layers, config.num_layers)
     stacked = jax.vmap(lambda r: _layer_init(r, config)[0])(layer_rngs)
     ln_f, _ = layers.rmsnorm_init(config.dim)
-    head, _ = layers.dense_init(
-        r_head, config.dim, config.vocab_size, in_axis="embed",
-        out_axis="vocab", use_bias=False,
-    )
-    return {"embed": embed, "layers": stacked, "ln_f": ln_f, "head": head}
+    params = {"embed": embed, "layers": stacked, "ln_f": ln_f}
+    if not config.tied_embeddings:
+        params["head"], _ = layers.dense_init(
+            r_head, config.dim, config.vocab_size, in_axis="embed",
+            out_axis="vocab", use_bias=False,
+        )
+    return params
 
 
 def param_logical_axes(config: TransformerConfig):
@@ -118,12 +124,14 @@ def param_logical_axes(config: TransformerConfig):
         lambda ax: ("layers",) + tuple(ax), layer_axes,
         is_leaf=lambda x: isinstance(x, tuple),
     )
-    return {
+    axes = {
         "embed": {"table": ("vocab", "embed")},
         "layers": stacked_axes,
         "ln_f": {"scale": (None,)},
-        "head": {"kernel": ("embed", "vocab")},
     }
+    if not config.tied_embeddings:
+        axes["head"] = {"kernel": ("embed", "vocab")}
+    return axes
 
 
 def _layer_init_axes(config: TransformerConfig):
@@ -314,9 +322,19 @@ def apply(
         )
 
     x = layers.rmsnorm_apply(params["ln_f"], x)
-    logits = layers.dense_apply(params["head"], x, dtype=jnp.float32)
+    logits = lm_logits(params, x, config)
     logits = shard_constraint(logits, "batch", "seq", "vocab", rules=rules, mesh=mesh)
     return logits, aux
+
+
+def lm_logits(params, x, config: TransformerConfig) -> jnp.ndarray:
+    """Final vocabulary projection in f32: the dedicated head kernel, or
+    the transposed token-embedding table under ``tied_embeddings`` —
+    shared with the generation path so tying can't drift between them."""
+    if config.tied_embeddings:
+        table = params["embed"]["table"].astype(jnp.float32)
+        return jnp.einsum("...d,vd->...v", x.astype(jnp.float32), table)
+    return layers.dense_apply(params["head"], x, dtype=jnp.float32)
 
 
 def loss_fn(
