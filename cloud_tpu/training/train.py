@@ -117,6 +117,7 @@ def make_train_step(
     rules: ShardingRules = DEFAULT_RULES,
     mesh: Optional[Mesh] = None,
     stochastic: bool = False,
+    accum_steps: int = 1,
 ):
     """Build ``step(state, batch) -> (state, metrics)``, jit-compiled.
 
@@ -128,22 +129,106 @@ def make_train_step(
     ``loss_fn(params, batch, rng=...)`` gets a fresh split every step
     (dropout et al.), and the state must have been created with a
     ``train_rng`` (``create_sharded_state(..., train_rng=key)``).
-    """
 
-    def step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+    ``accum_steps`` > 1 accumulates gradients over that many equal
+    micro-batches (batch dim 0 must divide) inside ONE optimizer update —
+    peak activation memory drops to one micro-batch's while the effective
+    batch stays whole.  For mean-reduced losses the accumulated gradient
+    equals the full-batch gradient exactly; scalar metrics are averaged
+    the same way.  The micro-batch loop is a ``lax.scan``, so the model
+    compiles once regardless of ``accum_steps``.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def _grad_fn(step_rng):
+        if stochastic:
+            return jax.value_and_grad(
+                partial(loss_fn, rng=step_rng), has_aux=True
+            )
+        return jax.value_and_grad(loss_fn, has_aux=True)
+
+    def _split_rng(state):
         next_rng = state.rng
+        step_rng = None
         if stochastic:
             if state.rng is None:
                 raise ValueError(
                     "stochastic=True needs a state built with train_rng"
                 )
             next_rng, step_rng = jax.random.split(state.rng)
-            grad_fn = jax.value_and_grad(
-                partial(loss_fn, rng=step_rng), has_aux=True
+        return next_rng, step_rng
+
+    def _accumulated_grads(params, batch, step_rng):
+        """Mean loss/grads/metrics over ``accum_steps`` micro-batches."""
+
+        def to_micro(x):
+            if x.shape[0] % accum_steps:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by "
+                    f"accum_steps={accum_steps}"
+                )
+            return x.reshape((accum_steps, x.shape[0] // accum_steps)
+                             + x.shape[1:])
+
+        micro = jax.tree_util.tree_map(to_micro, batch)
+        rngs = (
+            jax.random.split(step_rng, accum_steps)
+            if step_rng is not None else None
+        )
+
+        def body(acc, xs):
+            if rngs is not None:
+                mb, mb_rng = xs
+            else:
+                mb, mb_rng = xs, None
+            grad_fn = _grad_fn(mb_rng)
+            (_, metrics), grads = grad_fn(params, mb)
+            acc_g, acc_m = acc
+            acc_g = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), acc_g, grads
+            )
+            acc_m = {
+                k: acc_m[k] + metrics[k].astype(jnp.float32) for k in acc_m
+            }
+            return (acc_g, acc_m), None
+
+        # Accumulate in f32 regardless of param dtype (bf16 sums lose
+        # precision over many micro-batches); the mean is cast back to
+        # each param's dtype below so the optimizer sees the same grad
+        # dtypes as the accum_steps=1 path (donated opt_state buffers
+        # must keep their optimizer.init dtypes).
+        zero_g = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        # Metric structure comes from one abstract eval (no FLOPs spent);
+        # its shapes seed the accumulators so non-scalar metrics
+        # accumulate elementwise instead of crashing the scan carry.
+        metric_shapes = jax.eval_shape(
+            lambda p, b: _grad_fn(step_rng)(p, b)[0][1], params,
+            jax.tree_util.tree_map(lambda x: x[0], micro),
+        )
+        zero_m = {
+            k: jnp.zeros(v.shape, jnp.float32)
+            for k, v in metric_shapes.items()
+        }
+        xs = (micro, rngs) if rngs is not None else micro
+        (sum_g, sum_m), _ = jax.lax.scan(body, (zero_g, zero_m), xs)
+        inv = 1.0 / accum_steps
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g * inv).astype(p.dtype), sum_g, params
+        )
+        metrics = {k: v * inv for k, v in sum_m.items()}
+        return metrics, grads
+
+    def step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        next_rng, step_rng = _split_rng(state)
+        if accum_steps > 1:
+            metrics, grads = _accumulated_grads(
+                state.params, batch, step_rng
             )
         else:
-            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (_, metrics), grads = grad_fn(state.params, batch)
+            (_, metrics), grads = _grad_fn(step_rng)(state.params, batch)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )

@@ -220,6 +220,9 @@ class Trainer:
         ``loss_fn(params, batch, rng=...)`` (dropout etc.).  Eval steps
         stay deterministic (no rng passed).  ``init_state`` derives the
         training key from its rng automatically.
+      accum_steps: gradient accumulation — each train step splits its
+        batch into this many micro-batches and applies ONE optimizer
+        update with the mean gradient (train.make_train_step docstring).
     """
 
     def __init__(
@@ -232,6 +235,7 @@ class Trainer:
         logical_axes=None,
         rules: ShardingRules = DEFAULT_RULES,
         stochastic: bool = False,
+        accum_steps: int = 1,
     ):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -244,7 +248,7 @@ class Trainer:
         self.stop_training = False
         self._train_step = train_lib.make_train_step(
             loss_fn, optimizer, logical_axes=logical_axes, rules=rules,
-            mesh=mesh, stochastic=stochastic,
+            mesh=mesh, stochastic=stochastic, accum_steps=accum_steps,
         )
         self._eval_step = train_lib.make_eval_step(loss_fn)
 

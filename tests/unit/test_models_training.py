@@ -303,6 +303,83 @@ class TestBalancedRingAttention:
             transformer.apply(params, tokens, cfg, rules=rules, mesh=mesh)
 
 
+class TestGradAccumulation:
+    def _setup(self):
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        opt = optax.adamw(1e-3)
+        state = train_lib.create_sharded_state(
+            jax.random.PRNGKey(0),
+            functools.partial(transformer.init, config=cfg), opt, mesh=None,
+        )
+        rng = np.random.default_rng(0)
+        batch = {"tokens": rng.integers(0, 255, (8, 16)).astype(np.int32)}
+        return cfg, opt, state, batch
+
+    def test_matches_full_batch_update(self):
+        """Mean-reduced loss: 4 accumulated micro-batches produce the same
+        gradients — and therefore the same updated params — as one full
+        batch."""
+        cfg, opt, state, batch = self._setup()
+        loss = functools.partial(transformer.loss_fn, config=cfg, mesh=None)
+        full = train_lib.make_train_step(loss, opt)
+        accum = train_lib.make_train_step(loss, opt, accum_steps=4)
+        # The step donates its input state — give each call its own copy.
+        copy = lambda s: jax.tree_util.tree_map(jnp.copy, s)  # noqa: E731
+        s_full, m_full = full(copy(state), batch)
+        s_acc, m_acc = accum(copy(state), batch)
+        np.testing.assert_allclose(
+            float(m_full["loss"]), float(m_acc["loss"]), rtol=1e-6
+        )
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            s_full.params, s_acc.params,
+        )
+
+    def test_batch_must_divide(self):
+        cfg, opt, state, batch = self._setup()
+        loss = functools.partial(transformer.loss_fn, config=cfg, mesh=None)
+        step = train_lib.make_train_step(loss, opt, accum_steps=3)
+        with pytest.raises(ValueError, match="divisible"):
+            step(state, batch)  # 8 % 3 != 0
+
+    def test_stochastic_accumulation_uses_distinct_keys(self):
+        """Each micro-batch gets its own dropout key: accumulating the
+        SAME micro-batch twice must still see different masks (the loss
+        for identical halves differs from a plain half-batch step)."""
+        cfg = dataclasses.replace(bert.TINY, dropout_rate=0.3)
+        opt = optax.adamw(1e-3)
+        state = train_lib.create_sharded_state(
+            jax.random.PRNGKey(0),
+            functools.partial(bert.init, cfg=cfg), opt, mesh=None,
+            train_rng=jax.random.PRNGKey(7),
+        )
+        loss = functools.partial(bert.loss_fn, cfg=cfg)
+        half = {
+            "tokens": jnp.asarray([[1, 2, 3, 4]] * 2, jnp.int32),
+            "label": jnp.asarray([0, 1], jnp.int32),
+        }
+        doubled = jax.tree_util.tree_map(
+            lambda x: jnp.concatenate([x, x]), half
+        )
+        accum = train_lib.make_train_step(
+            loss, opt, stochastic=True, accum_steps=2
+        )
+        _, m = accum(state, doubled)
+        # If both micro-batches used the SAME key, the accumulated loss
+        # would equal a single half-batch evaluation exactly.
+        single, _ = loss(
+            train_lib.create_sharded_state(
+                jax.random.PRNGKey(0),
+                functools.partial(bert.init, cfg=cfg), opt, mesh=None,
+            ).params,
+            half,
+            rng=jax.random.split(jax.random.PRNGKey(7))[1],
+        )
+        assert float(m["loss"]) != float(single)
+
+
 class TestTiedEmbeddings:
     def test_no_head_params_and_trains(self):
         cfg = transformer.TINY.scaled(tied_embeddings=True)
