@@ -16,7 +16,6 @@ import ctypes
 import json
 import logging
 import os
-import subprocess
 import threading
 from typing import Dict, Optional
 
@@ -135,39 +134,11 @@ _registry = None
 _registry_lock = threading.Lock()
 
 
-def _lib_stale() -> bool:
-    """The .so is gitignored and survives pulls: compare mtimes in-process
-    so the steady state never pays a make subprocess (and concurrent
-    workers only race on make when a rebuild is genuinely needed)."""
-    if not os.path.exists(_LIB_PATH):
-        return True
-    lib_mtime = os.path.getmtime(_LIB_PATH)
-    for name in os.listdir(_CPP_DIR):
-        if name.endswith((".cc", ".h", "Makefile")):
-            if os.path.getmtime(os.path.join(_CPP_DIR, name)) > lib_mtime:
-                return True
-    return False
-
-
 def _build_native() -> Optional[ctypes.CDLL]:
-    if _lib_stale():
-        try:
-            subprocess.run(
-                ["make", "-C", _CPP_DIR, "libcloud_tpu_monitoring.so"],
-                check=True, capture_output=True, timeout=120,
-            )
-        except Exception as e:
-            if not os.path.exists(_LIB_PATH):
-                logger.info("native metrics build unavailable (%s); using "
-                            "pure-Python registry", e)
-                return None
-            logger.info("native metrics rebuild failed (%s); loading stale "
-                        "library", e)
-    try:
-        return ctypes.CDLL(_LIB_PATH)
-    except OSError as e:
-        logger.info("could not load %s (%s)", _LIB_PATH, e)
-        return None
+    from cloud_tpu.utils.native import load_native_lib
+
+    return load_native_lib(_CPP_DIR, "libcloud_tpu_monitoring.so",
+                           what="native metrics registry")
 
 
 def _get_registry():

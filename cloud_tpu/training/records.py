@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import glob as glob_lib
 import io
+import os
 import queue
 import struct
 import threading
@@ -37,13 +38,58 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence, Union
 import numpy as np
 
 # ---------------------------------------------------------------------------
-# crc32c (Castagnoli) — required by the TFRecord framing.  Pure-python
-# table-driven; fine for framing headers and test/bench-sized writes
-# (verification of payloads is opt-in via verify=True).
+# crc32c (Castagnoli) — required by the TFRecord framing.  Hot path lives
+# in the native library (training/cpp/records_native.cc, slicing-by-8,
+# ctypes-bound, built lazily like monitoring's registry); the pure-Python
+# table fallback keeps the format usable when no toolchain exists.
 # ---------------------------------------------------------------------------
 
 _CRC_POLY = 0x82F63B78
 _CRC_TABLE: Optional[List[int]] = None
+
+_CPP_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "libcloud_tpu_records.so")
+_native_lib = None
+_native_tried = False
+_native_lock = threading.Lock()
+
+
+def _native():
+    """Load (building if stale) the native records library via the shared
+    loader; None if that fails (pure-Python paths take over)."""
+    global _native_lib, _native_tried
+    if _native_tried:
+        return _native_lib
+    with _native_lock:
+        if _native_tried:
+            return _native_lib
+        import ctypes
+
+        from cloud_tpu.utils.native import load_native_lib
+
+        lib = load_native_lib(_CPP_DIR, "libcloud_tpu_records.so",
+                              what="native records hot path")
+        if lib is not None:
+            lib.ctpu_records_crc32c.restype = ctypes.c_uint32
+            lib.ctpu_records_crc32c.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64
+            ]
+            lib.ctpu_records_masked_crc32c.restype = ctypes.c_uint32
+            lib.ctpu_records_masked_crc32c.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64
+            ]
+            lib.ctpu_records_scan.restype = ctypes.c_int64
+            lib.ctpu_records_scan.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+        _native_lib = lib
+        _native_tried = True
+        return _native_lib
 
 
 def _table() -> List[int]:
@@ -59,7 +105,7 @@ def _table() -> List[int]:
     return _CRC_TABLE
 
 
-def crc32c(data: bytes) -> int:
+def _crc32c_python(data: bytes) -> int:
     table = _table()
     crc = 0xFFFFFFFF
     for b in data:
@@ -67,10 +113,20 @@ def crc32c(data: bytes) -> int:
     return crc ^ 0xFFFFFFFF
 
 
+def crc32c(data: bytes) -> int:
+    lib = _native()
+    if lib is not None:
+        return lib.ctpu_records_crc32c(data, len(data))
+    return _crc32c_python(data)
+
+
 def masked_crc32c(data: bytes) -> int:
     """TFRecord's rotated+offset crc (format spec: tensorflow
     core/lib/hash/crc32c.h)."""
-    crc = crc32c(data)
+    lib = _native()
+    if lib is not None:
+        return lib.ctpu_records_masked_crc32c(data, len(data))
+    crc = _crc32c_python(data)
     return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
 
 
@@ -138,10 +194,78 @@ class RecordWriter:
         self.close()
 
 
+#: Refill size for the native read path — bounds peak memory at roughly
+#: one chunk (+ one in-flight record) regardless of file size.
+_SCAN_CHUNK_BYTES = 8 * 1024 * 1024
+
+
+def _scan_records_native(f, path: str, verify: bool):
+    """Stream frames from file-like ``f`` via the native batch scanner:
+    read a chunk, parse every complete frame in ONE C call per 4096
+    records (crc verification included), keep the partial tail for the
+    next refill.  Constant memory in the file size; records larger than
+    the chunk grow the buffer only until their frame completes.
+
+    Error parity with the Python framing loop: frames scanned before a
+    corruption are yielded first, then the error raises.
+    """
+    import ctypes
+
+    lib = _native()
+    batch = 4096
+    offsets = (ctypes.c_uint64 * batch)()
+    lengths = (ctypes.c_uint64 * batch)()
+    consumed = ctypes.c_uint64()
+    status = ctypes.c_int32()
+    buf = bytearray()
+    eof = False
+    while True:
+        if not eof:
+            chunk = f.read(_SCAN_CHUNK_BYTES)
+            if chunk:
+                buf += chunk
+            else:
+                eof = True
+        pos = 0
+        # from_buffer: a pointer into the bytearray, no copy.  The buffer
+        # is not resized while scanning this fill.
+        base = (
+            ctypes.addressof(ctypes.c_char.from_buffer(buf)) if buf else 0
+        )
+        while pos < len(buf):
+            count = lib.ctpu_records_scan(
+                ctypes.c_void_p(base + pos), len(buf) - pos,
+                1 if verify else 0, offsets, lengths,
+                batch, ctypes.byref(consumed), ctypes.byref(status),
+            )
+            for i in range(count):
+                start = pos + offsets[i]
+                yield bytes(buf[start:start + lengths[i]])
+            if status.value == 1:
+                raise ValueError(f"corrupt record length crc in {path}")
+            if status.value == 2:
+                raise ValueError(f"corrupt record payload crc in {path}")
+            pos += consumed.value
+            if consumed.value == 0:
+                break  # partial frame — refill (or truncated at EOF)
+        if pos:
+            del buf[:pos]  # keep only the partial tail
+        if eof:
+            if buf:
+                raise ValueError(f"truncated record in {path}")
+            return
+
+
 def read_records(
     path: str, *, verify: bool = False, storage_client=None
 ) -> Iterator[bytes]:
-    """Stream raw record payloads from one TFRecord-framed file."""
+    """Stream raw record payloads from one TFRecord-framed file.
+
+    With the native library available, frames are parsed and
+    crc-verified by the batched C scanner over fixed-size refills
+    (constant memory); the framing-loop fallback streams record by
+    record in Python.
+    """
     if _is_gcs(path):
         from google.cloud import storage
 
@@ -150,6 +274,12 @@ def read_records(
         f = io.BytesIO(client.bucket(bucket).blob(name).download_as_bytes())
     else:
         f = open(path, "rb")
+    if _native() is not None:
+        try:
+            yield from _scan_records_native(f, path, verify)
+        finally:
+            f.close()
+        return
     try:
         while True:
             header = f.read(8)
