@@ -12,8 +12,8 @@ Every model module exposes the same functional surface:
 The reference's model surface was whatever Keras script the user shipped
 (golden workloads in core/tests/testdata/); this zoo carries the equivalent
 built-in workloads: MNIST dense (mnist_example_using_fit.py), ResNet50 /
-CIFAR-10, BERT fine-tune, and the flagship CloudLM decoder used for
-long-context and multi-axis parallelism.
+CIFAR-10, BERT fine-tune, ViT image classification, and the flagship
+CloudLM decoder used for long-context and multi-axis parallelism.
 """
 
 from cloud_tpu.models import layers  # noqa: F401

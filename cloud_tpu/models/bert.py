@@ -51,17 +51,9 @@ TINY = BertConfig(
 
 
 def _layer_init(rng, cfg: BertConfig):
-    r_att, r_mlp1, r_mlp2 = jax.random.split(rng, 3)
-    att, _ = layers.attention_block_init(r_att, cfg.dim, cfg.num_heads, cfg.head_dim)
-    ln1, _ = layers.layernorm_init(cfg.dim)
-    ln2, _ = layers.layernorm_init(cfg.dim)
-    wi, _ = layers.dense_init(
-        r_mlp1, cfg.dim, cfg.mlp_hidden, in_axis="embed", out_axis="mlp"
+    return layers.encoder_block_init(
+        rng, cfg.dim, cfg.num_heads, cfg.head_dim, cfg.mlp_hidden
     )
-    wo, _ = layers.dense_init(
-        r_mlp2, cfg.mlp_hidden, cfg.dim, in_axis="mlp", out_axis="embed"
-    )
-    return {"att": att, "ln1": ln1, "wi": wi, "wo": wo, "ln2": ln2}
 
 
 def init(rng, cfg: BertConfig = BERT_BASE) -> Dict[str, Any]:
@@ -83,13 +75,7 @@ def init(rng, cfg: BertConfig = BERT_BASE) -> Dict[str, Any]:
 
 
 def param_logical_axes(cfg: BertConfig = BERT_BASE):
-    layer_axes = {
-        "att": layers.attention_block_axes(),
-        "ln1": {"scale": (None,), "bias": (None,)},
-        "wi": layers.dense_axes("embed", "mlp"),
-        "wo": layers.dense_axes("mlp", "embed"),
-        "ln2": {"scale": (None,), "bias": (None,)},
-    }
+    layer_axes = layers.encoder_block_axes()
     stacked = jax.tree_util.tree_map(
         lambda ax: ("layers",) + tuple(ax), layer_axes,
         is_leaf=lambda x: isinstance(x, tuple),

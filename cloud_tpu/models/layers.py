@@ -268,6 +268,32 @@ def attention_block_init(rng, dim: int, num_heads: int, head_dim: int):
     return params, attention_block_axes()
 
 
+def encoder_block_axes():
+    """Axes for one pre/post-LN encoder block (attention + GELU MLP) —
+    shared by BERT and ViT so the stacked-layer tables can't drift."""
+    return {
+        "att": attention_block_axes(),
+        "ln1": {"scale": (None,), "bias": (None,)},
+        "wi": dense_axes("embed", "mlp"),
+        "wo": dense_axes("mlp", "embed"),
+        "ln2": {"scale": (None,), "bias": (None,)},
+    }
+
+
+def encoder_block_init(rng, dim: int, num_heads: int, head_dim: int,
+                       mlp_hidden: int):
+    """Init for :func:`encoder_block_axes`'s block."""
+    r_att, r_mlp1, r_mlp2 = jax.random.split(rng, 3)
+    att, _ = attention_block_init(r_att, dim, num_heads, head_dim)
+    ln1, _ = layernorm_init(dim)
+    ln2, _ = layernorm_init(dim)
+    wi, _ = dense_init(r_mlp1, dim, mlp_hidden, in_axis="embed",
+                       out_axis="mlp")
+    wo, _ = dense_init(r_mlp2, mlp_hidden, dim, in_axis="mlp",
+                       out_axis="embed")
+    return {"att": att, "ln1": ln1, "wi": wi, "wo": wo, "ln2": ln2}
+
+
 def mlp_block_axes():
     return {
         "wi": dense_axes("embed", "mlp", use_bias=False),

@@ -1,0 +1,199 @@
+"""Vision Transformer (ViT) for image classification.
+
+TPU-first layout: patch embedding is ONE dense matmul over flattened
+patches (``[B, N, P*P*C] @ [P*P*C, D]`` — a single large MXU op, no conv
+needed), the encoder is the shared pre-LN block vocabulary from
+``models/layers.py`` scanned with ``lax.scan``, and attention routes
+through ``layers.sharded_attention`` so the same dp/fsdp/tp mesh plans
+the other models use apply unchanged.
+
+The reference shipped no models (its golden workloads were user Keras
+scripts); ViT extends the built-in zoo beside ResNet for the vision
+workloads.  Follows the zoo contract: ``Config`` / ``init`` / ``apply`` /
+``param_logical_axes`` / ``loss_fn``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloud_tpu.models import layers
+from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules, shard_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    num_layers: int = 12
+    dim: int = 768
+    num_heads: int = 12
+    mlp_hidden: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    #: "cls" prepends a learned class token and classifies from it (the
+    #: original ViT); "gap" mean-pools patch tokens (no extra token, the
+    #: sequence stays a power of two — friendlier shapes on TPU).
+    pooling: str = "gap"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.num_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def scaled(self, **kw) -> "ViTConfig":
+        return dataclasses.replace(self, **kw)
+
+
+VIT_BASE_16 = ViTConfig()
+#: CIFAR-scale variant for tests and small benchmarks.
+VIT_TINY_CIFAR = ViTConfig(
+    image_size=32, patch_size=4, num_layers=4, dim=64, num_heads=4,
+    mlp_hidden=128, num_classes=10, remat=False,
+)
+
+
+def init(rng, cfg: ViTConfig = VIT_BASE_16) -> Dict[str, Any]:
+    if cfg.image_size % cfg.patch_size:
+        raise ValueError(
+            f"image_size {cfg.image_size} not divisible by patch_size "
+            f"{cfg.patch_size}"
+        )
+    if cfg.pooling not in ("gap", "cls"):
+        raise ValueError(
+            f'pooling must be "gap" or "cls", got {cfg.pooling!r}'
+        )
+    r_patch, r_pos, r_cls, r_layers, r_head = jax.random.split(rng, 5)
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.channels
+    patch, _ = layers.dense_init(
+        r_patch, patch_dim, cfg.dim, in_axis=None, out_axis="embed"
+    )
+    seq = cfg.num_patches + (1 if cfg.pooling == "cls" else 0)
+    pos = jax.random.normal(r_pos, (seq, cfg.dim), jnp.float32) * 0.02
+    layer_rngs = jax.random.split(r_layers, cfg.num_layers)
+    stacked = jax.vmap(
+        lambda r: layers.encoder_block_init(
+            r, cfg.dim, cfg.num_heads, cfg.head_dim, cfg.mlp_hidden
+        )
+    )(layer_rngs)
+    ln_f, _ = layers.layernorm_init(cfg.dim)
+    head, _ = layers.dense_init(
+        r_head, cfg.dim, cfg.num_classes, in_axis="embed", out_axis=None
+    )
+    params = {
+        "patch": patch, "pos": pos, "layers": stacked, "ln_f": ln_f,
+        "head": head,
+    }
+    if cfg.pooling == "cls":
+        params["cls"] = jnp.zeros((cfg.dim,), jnp.float32)
+    return params
+
+
+def param_logical_axes(cfg: ViTConfig = VIT_BASE_16):
+    layer_axes = layers.encoder_block_axes()
+    stacked = jax.tree_util.tree_map(
+        lambda ax: ("layers",) + tuple(ax), layer_axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    axes = {
+        "patch": layers.dense_axes(None, "embed"),
+        "pos": (None, "embed"),
+        "layers": stacked,
+        "ln_f": {"scale": (None,), "bias": (None,)},
+        "head": layers.dense_axes("embed", None),
+    }
+    if cfg.pooling == "cls":
+        axes["cls"] = ("embed",)
+    return axes
+
+
+def _patchify(images: jnp.ndarray, cfg: ViTConfig) -> jnp.ndarray:
+    """[B, H, W, C] -> [B, N, P*P*C] flattened patches (pure reshapes —
+    XLA fuses them into the patch matmul's operand layout)."""
+    b, h, w, c = images.shape
+    p = cfg.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def apply(
+    params,
+    images: jnp.ndarray,
+    cfg: ViTConfig = VIT_BASE_16,
+    *,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+) -> jnp.ndarray:
+    """images [B, H, W, C] -> logits [B, num_classes]."""
+    b = images.shape[0]
+    x = layers.dense_apply(
+        params["patch"], _patchify(images, cfg).astype(cfg.dtype)
+    )
+    if cfg.pooling == "cls":
+        cls = jnp.broadcast_to(
+            params["cls"].astype(cfg.dtype), (b, 1, cfg.dim)
+        )
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos"].astype(cfg.dtype)[None]
+    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules,
+                         mesh=mesh)
+    h, hd = cfg.num_heads, cfg.head_dim
+    t = x.shape[1]
+
+    def layer_body(x, lp):
+        y = layers.layernorm_apply(lp["ln1"], x)
+
+        def proj(p):
+            out = layers.dense_apply(p, y).reshape(b, t, h, hd)
+            return shard_constraint(out, "batch", "seq", "heads", None,
+                                    rules=rules, mesh=mesh)
+
+        attended = layers.sharded_attention(
+            proj(lp["att"]["q"]), proj(lp["att"]["k"]), proj(lp["att"]["v"]),
+            causal=False, rules=rules, mesh=mesh,
+        )
+        x = x + layers.dense_apply(
+            lp["att"]["out"], attended.reshape(b, t, -1)
+        )
+        y = layers.layernorm_apply(lp["ln2"], x)
+        x = x + layers.dense_apply(
+            lp["wo"], jax.nn.gelu(layers.dense_apply(lp["wi"], y))
+        )
+        x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules,
+                             mesh=mesh)
+        return x, None
+
+    body = jax.checkpoint(layer_body) if cfg.remat else layer_body
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layers.layernorm_apply(params["ln_f"], x)
+    pooled = x[:, 0] if cfg.pooling == "cls" else jnp.mean(x, axis=1)
+    return layers.dense_apply(params["head"], pooled, dtype=jnp.float32)
+
+
+def loss_fn(
+    params,
+    batch: Dict[str, jnp.ndarray],
+    cfg: ViTConfig = VIT_BASE_16,
+    *,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """batch = {"image": [B, H, W, C], "label": [B]}."""
+    logits = apply(params, batch["image"], cfg, rules=rules, mesh=mesh)
+    labels = batch["label"]
+    log_probs = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(
+        jnp.take_along_axis(log_probs, labels[:, None], axis=-1)
+    )
+    accuracy = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": accuracy}
