@@ -23,6 +23,9 @@ class MoeConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    #: Router z-loss (ST-MoE): penalizes ``logsumexp(logits)^2`` to keep
+    #: router logits small/stable in bf16 training.  0 disables.
+    z_loss_weight: float = 0.0
 
 
 def moe_mlp_init(rng, dim: int, hidden: int, cfg: MoeConfig):
@@ -113,4 +116,7 @@ def moe_mlp_apply(
     fraction_routed = jnp.mean(choice_mask[..., 0, :], axis=(0, 1))  # top-1 share
     mean_gate = jnp.mean(gates, axis=(0, 1))
     aux = jnp.sum(fraction_routed * mean_gate) * e * cfg.aux_loss_weight
+    if cfg.z_loss_weight:
+        z = jax.scipy.special.logsumexp(router_logits, axis=-1)  # [B, T]
+        aux = aux + cfg.z_loss_weight * jnp.mean(z * z)
     return out, aux

@@ -570,6 +570,22 @@ class TestTransformer:
 
 
 class TestMoeUnit:
+    def test_router_z_loss(self):
+        """z_loss adds a positive logsumexp^2 penalty whose gradient flows
+        to the router kernel (and nothing else changes when disabled)."""
+        cfg0 = moe.MoeConfig(num_experts=4, top_k=2)
+        cfg1 = moe.MoeConfig(num_experts=4, top_k=2, z_loss_weight=1e-3)
+        params, _ = moe.moe_mlp_init(jax.random.PRNGKey(0), 16, 32, cfg0)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+        out0, a0 = moe.moe_mlp_apply(params, x, cfg0)
+        out1, a1 = moe.moe_mlp_apply(params, x, cfg1)
+        np.testing.assert_array_equal(np.asarray(out0), np.asarray(out1))
+        assert float(a1) > float(a0)
+        g = jax.grad(
+            lambda p: moe.moe_mlp_apply(p, x, cfg1)[1]
+        )(params)
+        assert float(jnp.abs(g["router"]["kernel"]).sum()) > 0
+
     def test_top1_routing_capacity(self):
         cfg = moe.MoeConfig(num_experts=2, top_k=1, capacity_factor=2.0)
         params, _ = moe.moe_mlp_init(jax.random.PRNGKey(0), 8, 16, cfg)
