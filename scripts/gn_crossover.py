@@ -1,0 +1,72 @@
+"""A/B the fused GroupNorm Pallas kernel against the jnp (XLA-fused)
+path at the ResNet50/CIFAR stage shapes, value+grad, chain-then-read
+timing.  Prints one JSON line per (shape, path) plus a per-shape speedup
+summary — run on a real TPU after any kernel change, and to source the
+BASELINE.md dispatch notes.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+from cloud_tpu.ops import group_norm
+
+#: (B, H, W, C) per ResNet50/CIFAR stage (b256 step), plus the stem.
+SHAPES = [
+    (256, 32, 32, 64),    # stem
+    (256, 32, 32, 256),   # stage 1 out
+    (256, 16, 16, 512),   # stage 2 out
+    (256, 8, 8, 1024),    # stage 3 out
+    (256, 4, 4, 2048),    # stage 4 out
+]
+
+
+def bench(shape, use_pallas, groups=32, iters=30):
+    b, h, w, c = shape
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(k1, shape, jnp.bfloat16) * 2.0 + 3.0
+    scale = jax.random.normal(k2, (c,), jnp.float32) * 0.2 + 1.0
+    bias = jnp.zeros((c,), jnp.float32)
+
+    def loss(x, s, bi):
+        y = group_norm(x, s, bi, num_groups=groups, use_pallas=use_pallas,
+                       partitioned=False)
+        return jnp.mean(y.astype(jnp.float32) ** 2)
+
+    step = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+    val, grads = step(x, scale, bias)
+    float(val)
+    start = time.perf_counter()
+    acc = x
+    for _ in range(iters):
+        val, (gx, gs, gb) = step(acc, scale, bias)
+        acc = gx.astype(jnp.bfloat16)  # chain: data dependency per iter
+    float(jnp.sum(acc[..., 0].astype(jnp.float32)))
+    return (time.perf_counter() - start) / iters
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu":
+        print(f"SKIP: backend is {jax.default_backend()}, not tpu")
+        return 0
+    for shape in SHAPES:
+        ms_ref = bench(shape, use_pallas=False) * 1e3
+        ms_ker = bench(shape, use_pallas=True) * 1e3
+        print(json.dumps({
+            "shape": list(shape),
+            "xla_ms": round(ms_ref, 3),
+            "kernel_ms": round(ms_ker, 3),
+            "speedup": round(ms_ref / ms_ker, 3),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
