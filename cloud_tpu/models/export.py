@@ -1,0 +1,159 @@
+"""Pretrained-model bundles: (config, params) save/load for the zoo.
+
+A bundle is a directory holding ``config.json`` (the model's dataclass
+config plus the module that owns it) and an Orbax checkpoint of the
+params pytree.  ``load_pretrained`` reconstructs both without the caller
+knowing which model family the bundle contains — the handoff format
+between training jobs and inference (``models.generation``) or
+fine-tuning runs.
+
+The reference's analogue was ``tf.saved_model`` inside cloud_fit's
+serialization; here the split is deliberate: configs are
+human-readable JSON, params are sharded Orbax (restorable under any
+mesh), and code stays in the package — nothing is pickled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+
+#: Model families exportable by module name (the zoo contract: each has
+#: a Config dataclass named below plus init/apply).
+_CONFIG_CLASSES = {
+    "cloud_tpu.models.transformer": "TransformerConfig",
+    "cloud_tpu.models.bert": "BertConfig",
+    "cloud_tpu.models.vit": "ViTConfig",
+    "cloud_tpu.models.resnet": "ResNetConfig",
+}
+
+_DTYPE_KEY = "dtype"
+
+
+def _config_to_json(config: Any) -> dict:
+    module = type(config).__module__
+    if module not in _CONFIG_CLASSES:
+        raise ValueError(
+            f"unknown model family {module!r}; exportable families: "
+            f"{sorted(_CONFIG_CLASSES)}"
+        )
+    fields = dataclasses.asdict(config)
+    # dtypes aren't JSON; nested configs (MoeConfig) already became dicts.
+    if _DTYPE_KEY in fields:
+        fields[_DTYPE_KEY] = jnp.dtype(fields[_DTYPE_KEY]).name
+    return {"module": module, "config": fields}
+
+
+def _config_from_json(obj: dict) -> Any:
+    module_name = obj["module"]
+    class_name = _CONFIG_CLASSES.get(module_name)
+    if class_name is None:
+        raise ValueError(f"bundle's model family {module_name!r} unknown")
+    module = importlib.import_module(module_name)
+    cls = getattr(module, class_name)
+    fields = dict(obj["config"])
+    if _DTYPE_KEY in fields:
+        fields[_DTYPE_KEY] = jnp.dtype(fields[_DTYPE_KEY])
+    # Nested dataclass fields (e.g. TransformerConfig.moe) rebuild from
+    # their dict form via the field's declared type; JSON arrays come
+    # back as lists — the zoo's frozen configs use tuples (hashable,
+    # jit-static), so canonicalize.
+    for f in dataclasses.fields(cls):
+        value = fields.get(f.name)
+        if isinstance(value, dict) and dataclasses.is_dataclass(
+            _resolve_type(f, module)
+        ):
+            fields[f.name] = _resolve_type(f, module)(**value)
+        elif isinstance(value, list):
+            fields[f.name] = tuple(value)
+    return cls(**fields)
+
+
+def _resolve_type(field, module):
+    """Best-effort nested-dataclass type from a dataclass field (handles
+    the ``Optional[MoeConfig]`` annotation used in the zoo)."""
+    t = field.type
+    if not isinstance(t, str):
+        return t
+    for part in t.replace("Optional[", "").replace("]", "").split("."):
+        candidate = getattr(module, part, None)
+        if dataclasses.is_dataclass(candidate):
+            return candidate
+        if candidate is not None:
+            module = candidate
+    return type(None)
+
+
+def save_pretrained(directory: str, params: Any, config: Any) -> None:
+    """Write ``config.json`` + an Orbax params checkpoint to
+    ``directory`` (created if needed)."""
+    from cloud_tpu.training.checkpoint import CheckpointManager
+
+    import shutil
+
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, "config.json"), "w") as f:
+        json.dump(_config_to_json(config), f, indent=2, sort_keys=True)
+    params_dir = os.path.join(directory, "params")
+    # Re-exporting over an old bundle must replace the weights: orbax
+    # refuses to re-save an existing step, which would silently pair the
+    # NEW config.json with the OLD params.
+    if os.path.exists(params_dir):
+        shutil.rmtree(params_dir)
+    manager = CheckpointManager(params_dir, max_to_keep=1)
+    try:
+        if not manager.save(0, params):
+            raise RuntimeError(f"orbax declined to save params to {params_dir}")
+        manager.wait()
+    finally:
+        manager.close()
+
+
+def load_pretrained(
+    directory: str, *, template: Optional[Any] = None
+) -> Tuple[Any, Any]:
+    """Read a bundle back: returns ``(params, config)``.
+
+    ``template`` (a params pytree of the right structure, optionally
+    carrying shardings) restores into the given layout.  Without one, an
+    abstract template is built from the bundle's own config via
+    ``jax.eval_shape(module.init, ...)`` — no parameters materialize, and
+    orbax restores into the exact saved structure/dtypes.
+    """
+    import jax
+
+    from cloud_tpu.training.checkpoint import CheckpointManager
+
+    directory = os.path.abspath(directory)
+    with open(os.path.join(directory, "config.json")) as f:
+        obj = json.load(f)
+    config = _config_from_json(obj)
+    if template is None:
+        # Shapes/dtypes from the bundle's own config; restore to THIS
+        # host's default device rather than the sharding file (which
+        # orbax flags unsafe across topologies — a bundle saved on a
+        # mesh must load on a single inference box).
+        module = importlib.import_module(obj["module"])
+        template = jax.eval_shape(
+            lambda rng: module.init(rng, config), jax.random.PRNGKey(0)
+        )
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        template = jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                           sharding=sharding),
+            template,
+        )
+    manager = CheckpointManager(
+        os.path.join(directory, "params"), max_to_keep=1
+    )
+    try:
+        params = manager.restore(0, template=template)
+    finally:
+        manager.close()
+    return params, config

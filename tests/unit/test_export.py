@@ -1,0 +1,79 @@
+"""Pretrained-bundle tests: (config, params) round-trip for the zoo."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloud_tpu.models import bert, export, moe, resnet, transformer, vit
+
+
+def _assert_trees_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ),
+        a, b,
+    )
+
+
+class TestRoundTrip:
+    def test_transformer_with_nested_moe_config(self, tmp_path):
+        cfg = transformer.TINY.scaled(
+            dtype=jnp.float32, tied_embeddings=True,
+            moe=moe.MoeConfig(num_experts=4, top_k=2, z_loss_weight=1e-3),
+        )
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        export.save_pretrained(str(tmp_path / "m"), params, cfg)
+        params2, cfg2 = export.load_pretrained(str(tmp_path / "m"))
+        assert cfg2 == cfg  # includes the nested MoeConfig + dtype
+        _assert_trees_equal(params, params2)
+
+    @pytest.mark.parametrize("family,cfg", [
+        ("bert", bert.TINY),
+        ("vit", vit.VIT_TINY_CIFAR.scaled(num_layers=2)),
+        ("resnet", resnet.RESNET8_CIFAR),
+    ])
+    def test_other_families(self, tmp_path, family, cfg):
+        mod = {"bert": bert, "vit": vit, "resnet": resnet}[family]
+        params = mod.init(jax.random.PRNGKey(0), cfg)
+        export.save_pretrained(str(tmp_path / family), params, cfg)
+        params2, cfg2 = export.load_pretrained(str(tmp_path / family))
+        assert cfg2 == cfg
+        _assert_trees_equal(params, params2)
+
+    def test_loaded_bundle_generates(self, tmp_path):
+        from cloud_tpu.models import generation
+
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        export.save_pretrained(str(tmp_path / "lm"), params, cfg)
+        params2, cfg2 = export.load_pretrained(str(tmp_path / "lm"))
+        prompt = jnp.asarray([[5, 9, 17, 2]], jnp.int32)
+        lens = jnp.asarray([4], jnp.int32)
+        got = generation.generate(
+            params2, prompt, lens, cfg2, max_new_tokens=4,
+            sample=generation.SampleConfig(temperature=0.0),
+        )["tokens"]
+        want = generation.generate(
+            params, prompt, lens, cfg, max_new_tokens=4,
+            sample=generation.SampleConfig(temperature=0.0),
+        )["tokens"]
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_re_export_replaces_params(self, tmp_path):
+        """Saving over an existing bundle must ship the NEW weights —
+        orbax declines to re-save an existing step, which would silently
+        pair the new config with the old params."""
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        p1 = transformer.init(jax.random.PRNGKey(0), cfg)
+        p2 = transformer.init(jax.random.PRNGKey(1), cfg)
+        d = str(tmp_path / "m")
+        export.save_pretrained(d, p1, cfg)
+        export.save_pretrained(d, p2, cfg)
+        loaded, _ = export.load_pretrained(d)
+        _assert_trees_equal(loaded, p2)
+
+    def test_unknown_family_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown model family"):
+            export.save_pretrained(str(tmp_path / "x"), {}, object())
