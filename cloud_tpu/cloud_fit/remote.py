@@ -56,6 +56,8 @@ def run(remote_dir: str, *, mesh=None, storage_client=None) -> "object":
         mesh=mesh,
         logical_axes=spec.logical_axes,
         rules=spec.rules or _default_rules(),
+        stochastic=spec.stochastic,
+        accum_steps=spec.accum_steps,
     )
     # Init first: the fresh state doubles as the Orbax restore template
     # (checkpoint/resume — SURVEY.md §5 aux subsystems).
@@ -102,8 +104,17 @@ def _maybe_restore(trainer, state_dir: str) -> bool:
 
             manager = CheckpointManager(state_dir)
             if manager.latest_step() is not None:
-                template = jax.tree_util.tree_map(np.asarray, trainer.state)
-                trainer.state = manager.restore(template=template)
+                # Restore WITHOUT the rng leaf: a checkpoint written under
+                # the other stochastic setting has a different TrainState
+                # structure there, and a structure mismatch would silently
+                # retrain from scratch via the except below.  The fresh
+                # state's key (or None) carries forward instead.
+                current = trainer.state
+                template = jax.tree_util.tree_map(
+                    np.asarray, current.replace(rng=None)
+                )
+                restored = manager.restore(template=template)
+                trainer.state = restored.replace(rng=current.rng)
                 logger.info("restored checkpoint at step %s",
                             int(trainer.state.step))
                 return True

@@ -39,6 +39,12 @@ class TrainerSpec:
     logical_axes: Any = None
     rules: Any = None
     parallelism_hints: Any = None
+    #: Thread a PRNG key through train steps (dropout) — Trainer's
+    #: ``stochastic`` flag.
+    stochastic: bool = False
+    #: Gradient accumulation micro-batches per step — Trainer's
+    #: ``accum_steps``.
+    accum_steps: int = 1
 
 
 def _is_gcs(path: str) -> bool:

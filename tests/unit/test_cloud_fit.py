@@ -141,6 +141,92 @@ class TestCloudFitEndToEnd:
         assert "val_loss" in saved
         assert os.path.isdir(out / "checkpoint")
 
+    def test_remote_run_honors_accum_and_stochastic(self, tmp_path):
+        """TrainerSpec's stochastic/accum_steps flags reach the rebuilt
+        Trainer: training runs with gradient accumulation and a threaded
+        PRNG key."""
+        import optax
+
+        from cloud_tpu import parallel
+        from cloud_tpu.models import mnist
+
+        cfg = mnist.MnistConfig(hidden_dim=16)
+
+        def loss_with_rng(params, batch, rng=None):
+            # Stochastic mode requires an rng-accepting loss; mnist has
+            # no dropout, so the key is simply accepted and unused.
+            return mnist.loss_fn(params, batch, config=cfg)
+
+        spec = serialization.TrainerSpec(
+            loss_fn=loss_with_rng,
+            optimizer=optax.adam(1e-2),
+            init_fn=functools.partial(mnist.init, config=cfg),
+            logical_axes=mnist.param_logical_axes(cfg),
+            stochastic=True,
+            accum_steps=2,
+        )
+        serialization.serialize_assets(
+            str(tmp_path / "r"), spec, make_data(),
+            fit_kwargs={"epochs": 2, "batch_size": 8},
+        )
+        mesh = parallel.MeshSpec({"dp": 8}).build()
+        history = remote.run(str(tmp_path / "r"), mesh=mesh)
+        losses = history.history["loss"]
+        assert len(losses) == 2 and losses[-1] < losses[0]
+
+    def test_restore_survives_stochastic_flip(self, tmp_path):
+        """A deterministic checkpoint resumes under stochastic=True (and
+        would vice versa): the rng leaf is excluded from the restore
+        template, so the structure mismatch cannot silently retrain from
+        scratch."""
+        import jax
+
+        import optax
+
+        from cloud_tpu.models import mnist
+        from cloud_tpu.training import Trainer
+        from cloud_tpu.training import data as data_lib
+        from cloud_tpu.training.checkpoint import CheckpointManager
+
+        cfg = mnist.MnistConfig(hidden_dim=16)
+
+        def loss_with_rng(params, batch, rng=None):
+            return mnist.loss_fn(params, batch, config=cfg)
+
+        spec = serialization.TrainerSpec(
+            loss_fn=loss_with_rng,
+            optimizer=optax.adam(1e-2),
+            init_fn=functools.partial(mnist.init, config=cfg),
+            stochastic=True,  # resubmission flips dropout ON
+        )
+        serialization.serialize_assets(
+            str(tmp_path / "r"), spec, make_data(),
+            fit_kwargs={"epochs": 1, "batch_size": 8},
+        )
+        # Pre-train DETERMINISTICALLY (state has rng=None) and save.
+        trainer = Trainer(spec.loss_fn, spec.optimizer, init_fn=spec.init_fn)
+        trainer.init_state(jax.random.PRNGKey(0))
+        trainer.fit(data_lib.ArrayDataset(make_data(), 8), epochs=1)
+        pre_steps = int(trainer.state.step)
+        assert pre_steps > 0
+        mgr = CheckpointManager(str(tmp_path / "r" / "state"))
+        mgr.save(pre_steps, trainer.state)
+        mgr.wait()
+        mgr.close()
+
+        remote.run(str(tmp_path / "r"), mesh=None)
+        out = json.loads(
+            (tmp_path / "r" / "output" / "history.json").read_text()
+        )
+        assert out  # ran
+        # The final checkpoint's step proves the run RESUMED (pre_steps +
+        # one more epoch), not restarted from zero.
+        final = CheckpointManager(
+            str(tmp_path / "r" / "output" / "checkpoint")
+        )
+        assert final.latest_step() > pre_steps
+        final.close()
+
     def test_remote_run_restores_existing_state(self, tmp_path):
         """A checkpoint under remote_dir/state resumes training."""
         import jax
