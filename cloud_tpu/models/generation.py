@@ -38,9 +38,11 @@ from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules, shard_cons
 class SampleConfig:
     """Sampling hyperparameters (all static — they specialize the compile).
 
-    ``temperature=0`` means greedy (argmax); ``top_k``/``top_p`` are
-    applied in that order when set.  ``eos_id`` stops a sequence: the eos
-    token itself is emitted, and every slot after it holds ``pad_id``.
+    ``temperature=0`` means greedy (argmax); ``repetition_penalty`` /
+    ``top_k`` / ``top_p`` apply in that order when set.  ``eos_id``
+    stops a sequence: the eos token itself is emitted, and every slot
+    after it holds ``pad_id``; ``min_new_tokens`` suppresses eos until
+    that many tokens have been generated.
     """
 
     temperature: float = 1.0
@@ -48,10 +50,34 @@ class SampleConfig:
     top_p: Optional[float] = None
     eos_id: Optional[int] = None
     pad_id: int = 0
+    #: > 1.0 discourages tokens already generated this run (CTRL-style:
+    #: positive logits divided by, negative multiplied by the penalty).
+    #: Applies to greedy decoding too.
+    repetition_penalty: float = 1.0
+    #: eos is masked out of the logits for the first this-many sampled
+    #: tokens (forces a minimum generation length).
+    min_new_tokens: int = 0
 
 
-def sample_logits(rng, logits, sample: SampleConfig):
-    """One sampling step: logits [B, V] f32 -> token ids [B]."""
+def sample_logits(rng, logits, sample: SampleConfig, *, seen=None,
+                  allow_eos=None):
+    """One sampling step: logits [B, V] f32 -> token ids [B].
+
+    ``seen``: optional [B, V] bool — tokens already generated (the
+    repetition-penalty mask).  ``allow_eos``: optional [B] bool — False
+    masks ``eos_id`` out of the distribution (min_new_tokens).
+    """
+    if sample.repetition_penalty != 1.0 and seen is not None:
+        penalized = jnp.where(
+            logits > 0, logits / sample.repetition_penalty,
+            logits * sample.repetition_penalty,
+        )
+        logits = jnp.where(seen, penalized, logits)
+    if sample.eos_id is not None and allow_eos is not None:
+        eos_col = logits[:, sample.eos_id]
+        logits = logits.at[:, sample.eos_id].set(
+            jnp.where(allow_eos, eos_col, -jnp.inf)
+        )
     if sample.temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / sample.temperature
@@ -259,13 +285,25 @@ def generate(
     )
     logits0 = _final_logits(params, last_x, config)[:, 0]
     rng, step_rng = jax.random.split(rng)
-    tok0 = sample_logits(step_rng, logits0, sample).astype(jnp.int32)
+    track_seen = sample.repetition_penalty != 1.0
+    # Static gate: the allow-eos masking only enters the compiled loop
+    # when min_new_tokens actually constrains something.
+    need_min = sample.eos_id is not None and sample.min_new_tokens > 0
+    allow0 = jnp.full((b,), False) if need_min else None
+    tok0 = sample_logits(
+        step_rng, logits0, sample, allow_eos=allow0
+    ).astype(jnp.int32)
+    rows_b = jnp.arange(b)
+    seen0 = (
+        jnp.zeros((b, config.vocab_size), bool).at[rows_b, tok0].set(True)
+        if track_seen else jnp.zeros((), bool)  # static dummy carry slot
+    )
 
     # --- decode: one lax.scan over max_new_tokens steps ---
     # ``post_eos`` marks tokens STRICTLY after an eos: the eos itself is a
     # real emitted token; later slots are pads whose compute is discarded.
-    def step(carry, _):
-        cache_k, cache_v, cur_len, token, post_eos, rng = carry
+    def step(carry, i):
+        cache_k, cache_v, cur_len, token, post_eos, seen, rng = carry
         x = layers.embedding_apply(
             params["embed"], token[:, None], dtype=config.dtype,
             rules=rules, mesh=mesh,
@@ -284,23 +322,37 @@ def generate(
         )
         logits = _final_logits(params, x, config)[:, 0]
         rng, step_rng = jax.random.split(rng)
-        next_tok = sample_logits(step_rng, logits, sample).astype(jnp.int32)
+        # This step samples generated-token index i+1.
+        allow = (
+            jnp.full((b,), i + 1 >= sample.min_new_tokens)
+            if need_min else None
+        )
+        next_tok = sample_logits(
+            step_rng, logits, sample,
+            seen=seen if track_seen else None, allow_eos=allow,
+        ).astype(jnp.int32)
         done = post_eos
         if sample.eos_id is not None:
             done = post_eos | (token == sample.eos_id)
         next_tok = jnp.where(done, jnp.int32(sample.pad_id), next_tok)
+        if track_seen:
+            # Unconditional: done rows only ever produce pad_id, whose
+            # seen bit is unobservable (their sampling is discarded).
+            seen = seen.at[rows_b, next_tok].set(True)
         cur_len = cur_len + jnp.where(post_eos, 0, 1)
         emitted = jnp.where(post_eos, jnp.int32(sample.pad_id), token)
-        return (cache_k, cache_v, cur_len, next_tok, done, rng), emitted
+        return (
+            cache_k, cache_v, cur_len, next_tok, done, seen, rng
+        ), emitted
 
     # N-1 scan steps: step i consumes carried token i and samples token
     # i+1, so the last carried token needs no forward pass of its own —
     # it is emitted (and counted) directly from the final carry.  (With
     # max_new_tokens=1 the scan body never runs; tok0 came from prefill.)
     carry0 = (cache["k"], cache["v"], prompt_lens, tok0,
-              jnp.zeros((b,), bool), rng)
-    (_, _, cur_len, last_tok, last_post, _), emitted = jax.lax.scan(
-        step, carry0, None, length=max_new_tokens - 1
+              jnp.zeros((b,), bool), seen0, rng)
+    (_, _, cur_len, last_tok, last_post, _, _), emitted = jax.lax.scan(
+        step, carry0, jnp.arange(max_new_tokens - 1)
     )
     final_emit = jnp.where(last_post, jnp.int32(sample.pad_id), last_tok)
     final_len = cur_len + jnp.where(last_post, 0, 1)

@@ -173,6 +173,55 @@ class TestSampling:
         assert (toks[2:] == 0).all()  # ...and everything after is pad
         assert int(stopped["num_generated"][0]) == 2  # incl. the eos
 
+    def test_repetition_penalty_mechanism(self):
+        """sample_logits: a seen token's positive logit is divided (and a
+        negative one multiplied) by the penalty, demoting it below the
+        runner-up; unseen tokens are untouched."""
+        logits = jnp.asarray([[2.0, 1.0, 0.5], [-0.1, -2.0, -3.0]],
+                             jnp.float32)
+        seen = jnp.asarray([[True, False, False], [True, False, False]])
+        cfg = generation.SampleConfig(
+            temperature=0.0, repetition_penalty=100.0
+        )
+        picked = generation.sample_logits(None, logits, cfg, seen=seen)
+        # Row 0: 2.0/100 < 1.0 -> runner-up; row 1: -0.1*100 < -2.0 -> idx 1.
+        np.testing.assert_array_equal(np.asarray(picked), [1, 1])
+        # Without the seen mask, argmax is unchanged.
+        picked = generation.sample_logits(None, logits, cfg)
+        np.testing.assert_array_equal(np.asarray(picked), [0, 0])
+
+    def test_repetition_penalty_end_to_end_distinct(self):
+        """A huge penalty makes every greedy generated token distinct."""
+        config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(3), config)
+        prompt = jnp.asarray([[7, 3, 11, 2]], jnp.int32)
+        lens = jnp.asarray([4], jnp.int32)
+        penalized = np.asarray(generation.generate(
+            params, prompt, lens, config, max_new_tokens=8,
+            sample=generation.SampleConfig(
+                temperature=0.0, repetition_penalty=1e6
+            ),
+        )["tokens"])[0]
+        assert len(set(penalized.tolist())) == 8  # all distinct
+
+    def test_min_new_tokens_delays_eos(self):
+        config, params, prompt, lens = self._setup()
+        greedy = np.asarray(generation.generate(
+            params, prompt, lens, config, max_new_tokens=6,
+            sample=generation.SampleConfig(temperature=0.0),
+        )["tokens"])
+        eos = int(greedy[0, 0])  # make the FIRST greedy token the "eos"
+        out = generation.generate(
+            params, prompt, lens, config, max_new_tokens=6,
+            sample=generation.SampleConfig(
+                temperature=0.0, eos_id=eos, pad_id=0, min_new_tokens=3
+            ),
+        )
+        toks = np.asarray(out["tokens"])[0]
+        # eos masked out of indices 0-2: they hold real non-eos tokens.
+        assert all(int(t) != eos for t in toks[:3])
+        assert int(out["num_generated"][0]) >= 3
+
     def test_rng_required_for_sampling(self):
         config, params, prompt, lens = self._setup()
         with pytest.raises(ValueError, match="rng"):
