@@ -194,6 +194,44 @@ def _final_logits(params, x, config):
     return transformer.lm_logits(params, x, config)
 
 
+def _prefill(params, prompt_tokens, prompt_lens, config, s, rules, mesh):
+    """One full forward over the prompt buffer: returns the KV cache
+    (size ``s``, positions [0, prompt_len) filled) and the next-token
+    logits [B, V] at each row's last real prompt position — shared by
+    sampling and beam decoding."""
+    b, t_prompt = prompt_tokens.shape
+    cache = _init_cache(config, b, s, rules, mesh)
+    positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
+    prompt_mask = (positions < prompt_lens[:, None]).astype(jnp.int32)
+    x = layers.embedding_apply(params["embed"], prompt_tokens,
+                               dtype=config.dtype, rules=rules, mesh=mesh)
+    x = x * math.sqrt(config.dim)
+    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules,
+                         mesh=mesh)
+
+    def prefill_body(x, layer_slice):
+        layer_params, = layer_slice
+        x, k, v = _prefill_layer(layer_params, x, positions, prompt_mask,
+                                 config, rules, mesh)
+        return x, (k, v)
+
+    x, (k_pref, v_pref) = jax.lax.scan(
+        prefill_body, x, (params["layers"],)
+    )
+    cache["k"] = jax.lax.dynamic_update_slice(
+        cache["k"], k_pref.astype(config.dtype), (0, 0, 0, 0, 0)
+    )
+    cache["v"] = jax.lax.dynamic_update_slice(
+        cache["v"], v_pref.astype(config.dtype), (0, 0, 0, 0, 0)
+    )
+    last_idx = (prompt_lens - 1)[:, None, None]
+    last_x = jnp.take_along_axis(
+        x, jnp.broadcast_to(last_idx, (b, 1, x.shape[-1])), axis=1
+    )
+    logits0 = _final_logits(params, last_x, config)[:, 0]
+    return cache, logits0
+
+
 def generate(
     params,
     prompt_tokens: jnp.ndarray,
@@ -224,13 +262,7 @@ def generate(
       ``num_generated``: [B] count of generated tokens including the eos.
     """
     mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
-    if transformer._is_pipelined(config, rules, mesh):
-        raise ValueError(
-            "generation runs the scanned layer stack; pp pipelining is "
-            "training-only (drop the layers->pp rule for inference)"
-        )
-    if transformer._zigzag_active(config, mesh):
-        raise ValueError("zigzag_sp is training-only; disable for generation")
+    _check_inference_supported(config, rules, mesh, "generation")
     if sample.temperature != 0.0 and rng is None:
         raise ValueError("non-greedy sampling needs an rng key")
     rng = jax.random.PRNGKey(0) if rng is None else rng
@@ -250,40 +282,8 @@ def generate(
             "num_generated": jnp.zeros((b,), jnp.int32),
         }
     s = t_prompt + max_new_tokens
-    cache = _init_cache(config, b, s, rules, mesh)
-
-    # --- prefill: one full forward over the prompt buffer ---
-    positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
-    prompt_mask = (positions < prompt_lens[:, None]).astype(jnp.int32)
-    x = layers.embedding_apply(params["embed"], prompt_tokens,
-                               dtype=config.dtype, rules=rules, mesh=mesh)
-    x = x * math.sqrt(config.dim)
-    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules,
-                         mesh=mesh)
-
-    def prefill_body(x, layer_slice):
-        layer_params, = layer_slice
-        x, k, v = _prefill_layer(layer_params, x, positions, prompt_mask,
-                                 config, rules, mesh)
-        return x, (k, v)
-
-    x, (k_pref, v_pref) = jax.lax.scan(
-        prefill_body, x, (params["layers"],)
-    )
-    cache["k"] = jax.lax.dynamic_update_slice(
-        cache["k"], k_pref.astype(config.dtype), (0, 0, 0, 0, 0)
-    )
-    cache["v"] = jax.lax.dynamic_update_slice(
-        cache["v"], v_pref.astype(config.dtype), (0, 0, 0, 0, 0)
-    )
-
-    # First sampled token comes from the logits at each row's last real
-    # prompt position.
-    last_idx = (prompt_lens - 1)[:, None, None]
-    last_x = jnp.take_along_axis(
-        x, jnp.broadcast_to(last_idx, (b, 1, x.shape[-1])), axis=1
-    )
-    logits0 = _final_logits(params, last_x, config)[:, 0]
+    cache, logits0 = _prefill(params, prompt_tokens, prompt_lens, config,
+                              s, rules, mesh)
     rng, step_rng = jax.random.split(rng)
     track_seen = sample.repetition_penalty != 1.0
     # Static gate: the allow-eos masking only enters the compiled loop
@@ -381,4 +381,198 @@ def generate(
         "tokens": tokens,
         "sequences": sequences,
         "num_generated": final_len - prompt_lens,
+    }
+
+
+def _check_inference_supported(config, rules, mesh, what: str):
+    """Shared guard for the inference entry points: pp and zigzag layouts
+    are training-only."""
+    if transformer._is_pipelined(config, rules, mesh):
+        raise ValueError(
+            f"{what} runs the scanned layer stack; pp pipelining is "
+            "training-only (drop the layers->pp rule for inference)"
+        )
+    if transformer._zigzag_active(config, mesh):
+        raise ValueError(
+            f"zigzag_sp is training-only; disable it for {what}"
+        )
+
+
+def beam_search(
+    params,
+    prompt_tokens: jnp.ndarray,
+    prompt_lens: jnp.ndarray,
+    config: transformer.TransformerConfig,
+    *,
+    num_beams: int,
+    max_new_tokens: int,
+    length_penalty: float = 1.0,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+) -> Dict[str, Any]:
+    """Beam decoding: the highest-scoring continuation per prompt.
+
+    Length-penalized beam search over the KV-cache decoder, compiled as
+    one ``lax.scan`` like :func:`generate`.  Prefill runs once per
+    prompt; the cache tiles to ``B*K`` for decoding, and each step's
+    beam reorder gathers the cache along the beam dim.
+
+    Two hypothesis sets (the flax/t5x scheme): LIVE beams advance at raw
+    sum-logprob; a beam that samples eos moves into a FINISHED set scored
+    by ``sum_logprob / num_tokens**length_penalty`` and stops consuming
+    compute slots.  Each step expands 2K candidates so the live set stays
+    full even when K of them finish at once, and the final answer is the
+    best penalized hypothesis across both sets — a finished hypothesis
+    can never be evicted by a live beam that later collapses.
+
+    Returns dict with ``tokens`` [B, max_new_tokens] (best hypothesis,
+    pad after eos), ``scores`` [B] (its length-penalized log-prob), and
+    ``num_generated`` [B] (token count including the eos).
+    """
+    mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
+    _check_inference_supported(config, rules, mesh, "beam_search")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if max_new_tokens < 1:
+        raise ValueError("beam_search needs max_new_tokens >= 1")
+
+    b, t_prompt = prompt_tokens.shape
+    k = num_beams
+    s = t_prompt + max_new_tokens
+    prompt_lens = prompt_lens.astype(jnp.int32)
+    vocab = config.vocab_size
+    neg_inf = jnp.float32(-1e30)
+
+    def penalize(sum_logprob, n):
+        return sum_logprob / jnp.maximum(n.astype(jnp.float32), 1.0) ** (
+            length_penalty
+        )
+
+    cache, logits0 = _prefill(params, prompt_tokens, prompt_lens, config,
+                              s, rules, mesh)
+
+    # Tile the cache/prompt state to B*K (beam-major inside each batch row).
+    cache_k = jnp.repeat(cache["k"], k, axis=1)  # [L, B*K, S, H, hd]
+    cache_v = jnp.repeat(cache["v"], k, axis=1)
+    cur_len = jnp.repeat(prompt_lens, k)  # [B*K]
+
+    # Seed the live set with the top-K first tokens.  An eos seed moves
+    # straight to the finished set (its live copy is scored out).
+    logprobs0 = jax.nn.log_softmax(logits0, axis=-1)  # [B, V]
+    scores_l, tok0 = jax.lax.top_k(logprobs0, k)  # [B, K]
+    tok0 = tok0.astype(jnp.int32)
+    hist_l = jnp.full((b, k, max_new_tokens), pad_id, jnp.int32)
+    hist_l = hist_l.at[:, :, 0].set(tok0)
+    n_l = jnp.ones((b, k), jnp.int32)
+
+    hist_f = jnp.full((b, k, max_new_tokens), pad_id, jnp.int32)
+    scores_f = jnp.full((b, k), neg_inf)
+    n_f = jnp.zeros((b, k), jnp.int32)
+    if eos_id is not None:
+        seed_eos = tok0 == eos_id
+        scores_f = jnp.where(seed_eos, penalize(scores_l, n_l), scores_f)
+        hist_f = jnp.where(seed_eos[:, :, None], hist_l, hist_f)
+        n_f = jnp.where(seed_eos, n_l, n_f)
+        scores_l = jnp.where(seed_eos, neg_inf, scores_l)
+
+    def step(carry, i):
+        (cache_k, cache_v, cur_len, token, scores_l, hist_l, n_l,
+         scores_f, hist_f, n_f) = carry
+        x = layers.embedding_apply(
+            params["embed"], token.reshape(b * k)[:, None],
+            dtype=config.dtype, rules=rules, mesh=mesh,
+        )
+        x = x * math.sqrt(config.dim)
+
+        def layer_body(x, layer_slice):
+            layer_params, k_l, v_l = layer_slice
+            x, k_l, v_l = _decode_layer(
+                layer_params, x, k_l, v_l, cur_len, config, rules
+            )
+            return x, (k_l, v_l)
+
+        x, (cache_k, cache_v) = jax.lax.scan(
+            layer_body, x, (params["layers"], cache_k, cache_v)
+        )
+        logprobs = jax.nn.log_softmax(
+            _final_logits(params, x, config)[:, 0], axis=-1
+        ).reshape(b, k, vocab)
+        total = scores_l[:, :, None] + logprobs  # [B, K, V]
+
+        # 2K candidates so the live set refills even if K of them finish.
+        cand_scores, flat_idx = jax.lax.top_k(
+            total.reshape(b, k * vocab), 2 * k
+        )
+        cand_parent = (flat_idx // vocab).astype(jnp.int32)   # [B, 2K]
+        cand_tok = (flat_idx % vocab).astype(jnp.int32)
+        cand_hist = jnp.take_along_axis(
+            hist_l, cand_parent[:, :, None], axis=1
+        ).at[:, :, i + 1].set(cand_tok)
+        cand_n = jnp.take_along_axis(n_l, cand_parent, axis=1) + 1
+
+        if eos_id is not None:
+            cand_eos = cand_tok == eos_id
+            # Merge finishing candidates (penalized) into the finished set.
+            merged_scores = jnp.concatenate(
+                [scores_f,
+                 jnp.where(cand_eos, penalize(cand_scores, cand_n),
+                           neg_inf)],
+                axis=1,
+            )  # [B, K + 2K]
+            top_f, f_idx = jax.lax.top_k(merged_scores, k)
+            merged_hist = jnp.concatenate([hist_f, cand_hist], axis=1)
+            merged_n = jnp.concatenate([n_f, cand_n], axis=1)
+            scores_f = top_f
+            hist_f = jnp.take_along_axis(
+                merged_hist, f_idx[:, :, None], axis=1
+            )
+            n_f = jnp.take_along_axis(merged_n, f_idx, axis=1)
+            # Finishing candidates leave the live competition.
+            cand_scores = jnp.where(cand_eos, neg_inf, cand_scores)
+
+        # Keep the best K live candidates.
+        scores_l, l_idx = jax.lax.top_k(cand_scores, k)  # [B, K]
+        next_tok = jnp.take_along_axis(cand_tok, l_idx, axis=1)
+        hist_l = jnp.take_along_axis(cand_hist, l_idx[:, :, None], axis=1)
+        n_l = jnp.take_along_axis(cand_n, l_idx, axis=1)
+        live_parent = jnp.take_along_axis(cand_parent, l_idx, axis=1)
+
+        # Reorder the cache by the chosen live parents; all live beams
+        # advance, so cur_len bumps uniformly.
+        flat_parent = (
+            jnp.arange(b)[:, None] * k + live_parent
+        ).reshape(b * k)
+        cache_k = jnp.take(cache_k, flat_parent, axis=1)
+        cache_v = jnp.take(cache_v, flat_parent, axis=1)
+        cur_len = jnp.take(cur_len, flat_parent) + 1
+        return (
+            cache_k, cache_v, cur_len, next_tok, scores_l, hist_l, n_l,
+            scores_f, hist_f, n_f,
+        ), None
+
+    carry0 = (cache_k, cache_v, cur_len, tok0, scores_l, hist_l, n_l,
+              scores_f, hist_f, n_f)
+    (_, _, _, _, scores_l, hist_l, n_l, scores_f, hist_f, n_f), _ = (
+        jax.lax.scan(step, carry0, jnp.arange(max_new_tokens - 1))
+    )
+
+    # Final selection across both sets (live beams penalized now).
+    all_scores = jnp.concatenate(
+        [scores_f, penalize(scores_l, n_l)], axis=1
+    )  # [B, 2K]
+    all_hist = jnp.concatenate([hist_f, hist_l], axis=1)
+    all_n = jnp.concatenate([n_f, n_l], axis=1)
+    best = jnp.argmax(all_scores, axis=-1)  # [B]
+    return {
+        "tokens": jnp.take_along_axis(
+            all_hist, best[:, None, None], axis=1
+        )[:, 0],
+        "scores": jnp.take_along_axis(all_scores, best[:, None], axis=1)[
+            :, 0
+        ],
+        "num_generated": jnp.take_along_axis(all_n, best[:, None], axis=1)[
+            :, 0
+        ],
     }
