@@ -98,21 +98,29 @@ def save_pretrained(directory: str, params: Any, config: Any) -> None:
 
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
-    with open(os.path.join(directory, "config.json"), "w") as f:
-        json.dump(_config_to_json(config), f, indent=2, sort_keys=True)
+    config_json = _config_to_json(config)
     params_dir = os.path.join(directory, "params")
-    # Re-exporting over an old bundle must replace the weights: orbax
-    # refuses to re-save an existing step, which would silently pair the
-    # NEW config.json with the OLD params.
-    if os.path.exists(params_dir):
-        shutil.rmtree(params_dir)
-    manager = CheckpointManager(params_dir, max_to_keep=1)
+    tmp_dir = params_dir + ".saving"
+    # Durability ordering: write the NEW params to a temp dir first, swap
+    # them in only once fully saved, and write config.json LAST — a
+    # failure mid-save (disk full, kill) must leave either the old bundle
+    # intact or the new one complete, never a config-only shell.  (The
+    # swap also handles re-export: orbax silently declines to re-save an
+    # existing step, which would pair a new config with old params.)
+    if os.path.exists(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    manager = CheckpointManager(tmp_dir, max_to_keep=1)
     try:
         if not manager.save(0, params):
-            raise RuntimeError(f"orbax declined to save params to {params_dir}")
+            raise RuntimeError(f"orbax declined to save params to {tmp_dir}")
         manager.wait()
     finally:
         manager.close()
+    if os.path.exists(params_dir):
+        shutil.rmtree(params_dir)
+    os.rename(tmp_dir, params_dir)
+    with open(os.path.join(directory, "config.json"), "w") as f:
+        json.dump(config_json, f, indent=2, sort_keys=True)
 
 
 def load_pretrained(

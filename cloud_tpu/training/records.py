@@ -232,22 +232,32 @@ def _scan_records_native(f, path: str, verify: bool):
         base = (
             ctypes.addressof(ctypes.c_char.from_buffer(buf)) if buf else 0
         )
-        while pos < len(buf):
-            count = lib.ctpu_records_scan(
-                ctypes.c_void_p(base + pos), len(buf) - pos,
-                1 if verify else 0, offsets, lengths,
-                batch, ctypes.byref(consumed), ctypes.byref(status),
-            )
-            for i in range(count):
-                start = pos + offsets[i]
-                yield bytes(buf[start:start + lengths[i]])
-            if status.value == 1:
-                raise ValueError(f"corrupt record length crc in {path}")
-            if status.value == 2:
-                raise ValueError(f"corrupt record payload crc in {path}")
-            pos += consumed.value
-            if consumed.value == 0:
-                break  # partial frame — refill (or truncated at EOF)
+        # One memoryview per fill, released before the tail-trim below (a
+        # live export blocks bytearray resizing); slicing the view keeps
+        # payload extraction at ONE copy instead of bytearray-slice + bytes.
+        view = memoryview(buf) if buf else None
+        try:
+            while pos < len(buf):
+                count = lib.ctpu_records_scan(
+                    ctypes.c_void_p(base + pos), len(buf) - pos,
+                    1 if verify else 0, offsets, lengths,
+                    batch, ctypes.byref(consumed), ctypes.byref(status),
+                )
+                for i in range(count):
+                    start = pos + offsets[i]
+                    yield bytes(view[start:start + lengths[i]])
+                if status.value == 1:
+                    raise ValueError(f"corrupt record length crc in {path}")
+                if status.value == 2:
+                    raise ValueError(
+                        f"corrupt record payload crc in {path}"
+                    )
+                pos += consumed.value
+                if consumed.value == 0:
+                    break  # partial frame — refill (or truncated at EOF)
+        finally:
+            if view is not None:
+                view.release()
         if pos:
             del buf[:pos]  # keep only the partial tail
         if eof:

@@ -81,6 +81,20 @@ def main():
                 int(c) for c in np.asarray(out["sequences"])[i][:n_real]
             ).decode(errors="replace")
             print(f"{name:8s} | {text!r}")
+
+    beam = generation.beam_search(
+        trainer.state.params,
+        jnp.asarray(prompt_tokens),
+        jnp.asarray(prompt_lens),
+        config,
+        num_beams=4,
+        max_new_tokens=24,
+    )
+    for i, p in enumerate(prompts):
+        text = bytes(
+            int(c) for c in np.asarray(beam["tokens"])[i] if c
+        ).decode(errors="replace")
+        print(f"beam-4   | {p + text!r}  (score {float(beam['scores'][i]):.3f})")
     return trainer
 
 
