@@ -3,31 +3,50 @@
 Measures the BASELINE.json north-star workload (ResNet50 steps/sec/chip,
 CIFAR-10 config) on the available accelerator and prints ONE JSON line:
 ``{"metric", "value", "unit", "vs_baseline", ...}``.  Alongside the
-headline number the line carries the context VERDICT r2 demanded:
+headline number the line carries context:
 
-* ``tflops_per_sec`` / ``mfu`` — achieved model FLOP/s and utilization,
-  computed from XLA's compiled cost analysis (fwd+bwd FLOPs of the exact
-  step that ran) against the chip's bf16 peak.
-* ``bert_*`` — the BERT-base fine-tune config (BASELINE config 3) measured
-  on the framework's auto-dispatched attention path (at T=128 that is
-  XLA's fused attention — the Pallas kernel only wins at T >= 1024, see
-  ops/flash_attention.MIN_SEQ_LEN_FOR_KERNEL), with its own MFU from
-  analytic FLOPs.
-* ``flash_attention_ok`` — a real-hardware Pallas gate: the flash kernel
-  (forward + backward) is compiled on the device and compared against the
-  jnp reference; a Mosaic regression can no longer ship undetected
-  (VERDICT r2 weak #8).
+* ``tflops_per_sec`` / ``mfu`` — achieved model FLOP/s and utilization for
+  the CIFAR config (from XLA's compiled cost analysis).
+* ``resnet224_*`` — the MFU-honest vision workload (ImageNet-shape
+  224x224 b128 bf16 ResNet50) whose utilization the MXU can actually
+  demonstrate; the CIFAR number stays the regression canary (BASELINE.md
+  "ResNet ceiling").
+* ``bert_*`` — the BERT-base fine-tune config (BASELINE config 3) on the
+  framework's auto-dispatched attention path, with analytic-FLOPs MFU.
+* ``flash_attention_ok`` / ``group_norm_kernel_ok`` — real-hardware
+  Pallas gates: kernels compiled on the device and compared against the
+  jnp reference, so a Mosaic regression cannot ship undetected.
 
 Survivability contract (the TPU endpoint is reached through a tunnel that
-can hang or come up UNAVAILABLE): the measurement itself runs in a child
-process with a hard wall-clock budget; the parent retries with backoff on
-failure and, if every attempt dies, still emits a single structured JSON
-line carrying an ``error`` field — the driver always captures something
-diagnosable, never a bare traceback or a hang.
+can HANG — not error — for hours; round 3's driver run recorded 0.0
+because three 420 s attempts all hit a hung tunnel):
+
+1. **Cheap probe first.**  A ~60 s child runs ``jax.devices()`` plus one
+   tiny chained matmul.  While the probe fails, the parent retries the
+   probe on backoff — burning ~1 min per try instead of a 420 s attempt —
+   until the total budget nears exhaustion.
+2. **Headline first, one JSON line per phase.**  The measurement child
+   measures the CIFAR ResNet headline FIRST and prints its JSON line
+   immediately, then runs gates / BERT / ResNet-224, each phase printing
+   its own line as it completes.  A hang mid-child forfeits only the
+   phases not yet printed: the parent salvages every line already on
+   stdout (``subprocess.TimeoutExpired`` carries the partial output).
+   In-child SIGALRM watchdogs are deliberately NOT used — the observed
+   hangs are C-level calls into the tunnel runtime that never return to
+   the bytecode loop, so signal delivery cannot be relied on; the only
+   trustworthy watchdog is the parent killing the child.
+3. **Degrade, don't forfeit.**  Kernel gates run AFTER the headline; a
+   diverging GroupNorm kernel triggers an in-child re-measure on the jnp
+   path (corrected line supersedes).  If an attempt times out with no
+   headline, the next attempt disables the GroupNorm kernel up front.
+4. **Spend the whole budget.**  Attempts repeat (with a fresh probe
+   between them) while budget remains, instead of a fixed small count.
+   If everything fails the parent still emits a single structured JSON
+   line with ``value 0.0`` and the error trail — never a hang.
 
 The reference publishes no numbers (BASELINE.md: "published": {}), so
 ``vs_baseline`` is reported against this repo's own recorded baseline —
-the round-2 measurement recorded in BASELINE.md.
+the last driver-verified measurement (BENCH_r02.json).
 """
 
 import json
@@ -45,21 +64,27 @@ BERT_SEQ = 128
 BERT_WARMUP = 3
 BERT_MEASURE = 20
 
+R224_BATCH = 128
+R224_WARMUP = 3
+R224_MEASURE = 10
+
 METRIC = f"resnet50_cifar10_b{BATCH_SIZE}_train_steps_per_sec_per_chip"
 
-#: The first honestly-timed recorded run (BENCH_r02.json, 2026-07-29, TPU
-#: v5e-1, chain-then-read contract — see BASELINE.md "Timing methodology").
+#: The last DRIVER-VERIFIED number (BENCH_r02.json, 2026-07-29, TPU v5e-1,
+#: chain-then-read contract).  The round-3 in-session measurement (171.4)
+#: is not used: its driver artifact (BENCH_r03.json) recorded 0.0.
 RECORDED_BASELINE_STEPS_PER_SEC = 162.74
 
+#: Probe budget: jax import + device enumeration + one tiny matmul.
+PROBE_TIMEOUT_S = float(os.environ.get("CLOUD_TPU_BENCH_PROBE_TIMEOUT", 75))
 #: Per-attempt wall-clock budget.  First TPU compile on this endpoint is
-#: ~20-40 s per program and the child compiles three (ResNet step, BERT
-#: step, flash-attention check); the budget leaves room for a slow tunnel
-#: without letting a hung backend eat the whole round.
+#: ~20-40 s per program; the headline needs just one compile and prints
+#: within ~1-2 min of child start — the rest of the budget is context.
 ATTEMPT_TIMEOUT_S = float(os.environ.get("CLOUD_TPU_BENCH_ATTEMPT_TIMEOUT", 420))
-#: Total budget across attempts, including backoff sleeps.
+#: Total budget across probes, attempts, and backoff sleeps.
 TOTAL_BUDGET_S = float(os.environ.get("CLOUD_TPU_BENCH_TOTAL_BUDGET", 1200))
-MAX_ATTEMPTS = int(os.environ.get("CLOUD_TPU_BENCH_MAX_ATTEMPTS", 3))
-BACKOFF_BASE_S = 10.0
+PROBE_BACKOFF_S = 20.0
+ATTEMPT_BACKOFF_S = 15.0
 
 
 def _peak_bf16_tflops(device) -> float:
@@ -126,7 +151,47 @@ def _throughput(step, state, batch, *, warmup, iters):
     )
 
 
-def _measure_resnet(extras):
+def _emit_phase(phase, **payload):
+    print(json.dumps({"phase": phase, **payload}), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Probe child: the cheapest possible proof the tunnel is alive.
+
+
+def _probe_main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    devices = jax.devices()
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    y = x
+    for _ in range(3):  # chained — a hung tunnel cannot satisfy the read
+        y = y @ x
+    checksum = float(y.astype(jnp.float32).sum())
+    _emit_phase(
+        "probe",
+        ok=True,
+        n_devices=len(devices),
+        device_kind=getattr(devices[0], "device_kind", "?"),
+        backend=jax.default_backend(),
+        checksum=checksum,
+    )
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Measurement child: headline first, one salvageable JSON line per phase.
+
+
+def _measure_resnet_config(extras, prefix, config, *, image_hw, num_classes,
+                           batch_size, warmup, iters):
+    """One ResNet train-step measurement: build state, AOT-compile, time.
+
+    Returns steps/sec.  With mesh=None the step executes on ONE device
+    however many the endpoint exposes, so the measured rate already IS
+    per-chip — dividing by len(jax.devices()) would under-report N-fold.
+    """
     import functools
 
     import jax
@@ -135,9 +200,6 @@ def _measure_resnet(extras):
 
     from cloud_tpu.models import resnet
     from cloud_tpu.training import train as train_lib
-
-    n_chips = len(jax.devices())
-    config = resnet.RESNET50_CIFAR
 
     state = train_lib.create_sharded_state(
         jax.random.PRNGKey(0),
@@ -152,19 +214,68 @@ def _measure_resnet(extras):
 
     rng = np.random.default_rng(0)
     batch = {
-        "image": rng.normal(size=(BATCH_SIZE, 32, 32, 3)).astype(np.float32),
-        "label": rng.integers(0, 10, BATCH_SIZE),
+        "image": rng.normal(
+            size=(batch_size, image_hw, image_hw, 3)
+        ).astype(np.float32),
+        "label": rng.integers(0, num_classes, batch_size),
     }
     batch = jax.device_put(batch)
 
-    extras["device_kind"] = getattr(jax.devices()[0], "device_kind", "?")
-    extras["peak_bf16_tflops"] = _peak_bf16_tflops(jax.devices()[0])
     compiled, flops = _compile_step(step, state, batch)
     steps_per_sec = _throughput(
-        compiled, state, batch, warmup=WARMUP_STEPS, iters=MEASURE_STEPS
+        compiled, state, batch, warmup=warmup, iters=iters
     )
-    _add_flops_context(extras, "", flops, steps_per_sec)
-    return steps_per_sec / n_chips
+    _add_flops_context(extras, prefix, flops, steps_per_sec)
+    return steps_per_sec
+
+
+def _measure_resnet(extras, *, corrected=False):
+    """The headline: CIFAR-shape ResNet50 (the regression canary)."""
+    import jax
+
+    from cloud_tpu.models import resnet
+
+    extras["device_kind"] = getattr(jax.devices()[0], "device_kind", "?")
+    extras["peak_bf16_tflops"] = _peak_bf16_tflops(jax.devices()[0])
+    extras["group_norm_kernel_used"] = (
+        os.environ.get("CLOUD_TPU_GN_KERNEL", "1") != "0"
+    )
+    steps_per_sec = _measure_resnet_config(
+        extras, "", resnet.RESNET50_CIFAR, image_hw=32, num_classes=10,
+        batch_size=BATCH_SIZE, warmup=WARMUP_STEPS, iters=MEASURE_STEPS,
+    )
+    _emit_phase(
+        "resnet", ok=True, value=steps_per_sec, corrected=corrected,
+        extras=extras,
+    )
+    return steps_per_sec
+
+
+def _measure_resnet224(extras):
+    """ImageNet-shape ResNet50: the workload whose MFU means something.
+
+    224x224 b128 bf16 activations; per-step FLOPs from XLA cost analysis.
+    The Pallas GroupNorm kernel DOES dispatch for the mid-network stages
+    here and its custom calls report 0 FLOPs — but normalization is <1%
+    of this program's FLOPs (the 224x224 convs dominate and are XLA
+    convs, fully counted), so the MFU undercount is within ~1%.  CIFAR
+    stays the headline/regression number; this is the utilization claim.
+    """
+    from cloud_tpu.models import resnet
+
+    # Record which GroupNorm path this phase actually ran: an earlier
+    # in-child divergence (or a parent retry) flips the kill switch, and
+    # the utilization claim must not be attributed to the kernel path
+    # when the jnp path measured it.
+    extras["resnet224_gn_kernel_used"] = (
+        os.environ.get("CLOUD_TPU_GN_KERNEL", "1") != "0"
+    )
+    steps_per_sec = _measure_resnet_config(
+        extras, "resnet224_", resnet.RESNET50, image_hw=224,
+        num_classes=1000, batch_size=R224_BATCH, warmup=R224_WARMUP,
+        iters=R224_MEASURE,
+    )
+    extras["resnet224_steps_per_sec"] = round(steps_per_sec, 3)
 
 
 def _bert_analytic_flops(cfg, batch_size, seq_len) -> float:
@@ -292,10 +403,9 @@ def _check_flash_attention(extras):
 
 
 def _check_group_norm(extras):
-    """Compile the fused GroupNorm kernel (fwd+bwd) on the device BEFORE
-    the ResNet measurement depends on it.  On failure the kernel is
-    disabled via CLOUD_TPU_GN_KERNEL=0 so ResNet still measures on the
-    jnp path; the extras record the degradation."""
+    """Compile the fused GroupNorm kernel (fwd+bwd) on the device and
+    compare against the jnp reference.  Raises on divergence so the
+    caller can re-measure ResNet on the jnp path."""
     import jax
     import jax.numpy as jnp
 
@@ -303,6 +413,14 @@ def _check_group_norm(extras):
 
     if jax.default_backend() != "tpu":
         extras["group_norm_kernel_ok"] = None
+        return
+    if os.environ.get("CLOUD_TPU_GN_KERNEL", "1") == "0":
+        # Kill switch set (e.g. the parent's retry after a headline-less
+        # timeout): group_norm() short-circuits to the jnp path for EVERY
+        # call, including our use_pallas=True one — the comparison would
+        # be reference-vs-reference.  Report "not exercised", not "ok".
+        extras["group_norm_kernel_ok"] = None
+        extras["group_norm_kernel_skipped"] = "CLOUD_TPU_GN_KERNEL=0"
         return
     k1, k2 = jax.random.split(jax.random.PRNGKey(3))
     x = jax.random.normal(k1, (4, 8, 8, 128), jnp.bfloat16) * 2.0 + 5.0
@@ -334,29 +452,106 @@ def _check_group_norm(extras):
 
 
 def _child_main() -> int:
+    """Headline first; every phase prints its own salvageable JSON line."""
     extras = {}
+    # Phase 1: the headline.  GroupNorm kernel state comes from the
+    # environment (parent disables it on a retry after a headline-less
+    # timeout).  Nothing runs before this.
     try:
-        _check_group_norm(extras)
-    except Exception as exc:  # noqa: BLE001 — degrade, don't die
-        os.environ["CLOUD_TPU_GN_KERNEL"] = "0"
-        extras["group_norm_kernel_ok"] = False
-        extras["group_norm_error"] = f"{type(exc).__name__}: {exc}"[:500]
-    try:
-        per_chip = _measure_resnet(extras)
+        _measure_resnet(extras)
     except Exception as exc:  # noqa: BLE001 — relayed to the parent as data
-        print(json.dumps({"ok": False, "error": f"{type(exc).__name__}: {exc}"[:2000]}),
-              flush=True)
+        _emit_phase(
+            "resnet", ok=False, error=f"{type(exc).__name__}: {exc}"[:2000]
+        )
         return 1
-    # Context measurements must never sink the headline number.
-    for fn, tag in ((_check_flash_attention, "flash_attention"),
-                    (_measure_bert, "bert")):
+
+    # Phase 2: GroupNorm correctness gate.  The headline above used the
+    # kernel (unless env-disabled); if the gate diverges, the printed
+    # number is suspect — disable the kernel and re-measure, printing a
+    # corrected headline line (the parent takes the LAST resnet line).
+    gn_extras = {}
+    try:
+        _check_group_norm(gn_extras)
+        _emit_phase("group_norm", ok=True, extras=gn_extras)
+    except Exception as exc:  # noqa: BLE001 — degrade, don't die
+        gn_extras["group_norm_kernel_ok"] = False
+        gn_extras["group_norm_error"] = f"{type(exc).__name__}: {exc}"[:500]
+        _emit_phase("group_norm", ok=False, extras=gn_extras)
+        if os.environ.get("CLOUD_TPU_GN_KERNEL", "1") != "0":
+            os.environ["CLOUD_TPU_GN_KERNEL"] = "0"
+            try:
+                corrected = dict(gn_extras)
+                _measure_resnet(corrected, corrected=True)
+            except Exception as exc2:  # noqa: BLE001
+                _emit_phase(
+                    "resnet_correction_failed", ok=False,
+                    error=f"{type(exc2).__name__}: {exc2}"[:500],
+                )
+
+    # Phase 3+: context.  Each must never sink the phases already printed.
+    for fn, tag in (
+        (_check_flash_attention, "flash_attention"),
+        (_measure_bert, "bert"),
+        (_measure_resnet224, "resnet224"),
+    ):
+        phase_extras = {"peak_bf16_tflops": extras.get("peak_bf16_tflops")}
         try:
-            fn(extras)
+            fn(phase_extras)
+            phase_extras.pop("peak_bf16_tflops", None)
+            _emit_phase(tag, ok=True, extras=phase_extras)
         except Exception as exc:  # noqa: BLE001
-            extras[f"{tag}_error"] = f"{type(exc).__name__}: {exc}"[:500]
-    print(json.dumps({"ok": True, "value": per_chip, "extras": extras}),
-          flush=True)
+            _emit_phase(
+                tag, ok=False,
+                error=f"{type(exc).__name__}: {exc}"[:500],
+            )
     return 0
+
+
+# --------------------------------------------------------------------------
+# Parent: probe loop -> attempts -> salvage -> single JSON line.
+
+
+def _decode_stream(raw) -> str:
+    if raw is None:
+        return ""
+    if isinstance(raw, bytes):
+        return raw.decode("utf-8", "replace")
+    return raw
+
+
+def _run_child(mode: str, timeout: float, env=None):
+    """Run a child; returns (parsed phase lines, error string or '')."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), mode],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
+        )
+        stdout, stderr = proc.stdout, proc.stderr
+        rc: "int | None" = proc.returncode
+        err = ""
+    except subprocess.TimeoutExpired as exc:
+        # run() attaches output captured before the kill; under text=True
+        # it has still been observed as bytes — decode defensively.
+        stdout = _decode_stream(exc.stdout)
+        stderr = _decode_stream(exc.stderr)
+        rc = None
+        err = f"timed out after {timeout:.0f}s"
+    lines = []
+    for line in (stdout or "").splitlines():
+        try:
+            candidate = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(candidate, dict) and "phase" in candidate:
+            lines.append(candidate)
+    if not err and rc not in (0, None) and not lines:
+        tail = (stderr or stdout or "").strip()[-300:]
+        err = f"child rc={rc}, tail={tail!r}"
+    return lines, err
 
 
 def _emit(value: float, *, extras=None, error: str = "") -> None:
@@ -377,54 +572,129 @@ def _emit(value: float, *, extras=None, error: str = "") -> None:
     print(json.dumps(record), flush=True)
 
 
+def _push_error(errors, message):
+    """Bounded error trail: a long probe loop must not accumulate an
+    unbounded list (the final join would materialize it all)."""
+    if len(errors) < 40:
+        errors.append(message)
+    elif len(errors) == 40:
+        errors.append("... further errors suppressed")
+
+
 def main() -> int:
     deadline = time.monotonic() + TOTAL_BUDGET_S
     errors = []
-    for attempt in range(MAX_ATTEMPTS):
+    merged = {}
+    headline = None
+    attempt = 0
+    force_gn_off = False
+    # The probe must see a real TPU: on an UNAVAILABLE (rather than hung)
+    # tunnel JAX falls back to CPU with only a warning, and a CPU-measured
+    # "headline" must never be published as the TPU number of record.  An
+    # explicit JAX_PLATFORMS=cpu pin (the CPU test path) opts out.
+    allow_cpu = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+
+    while True:
         remaining = deadline - time.monotonic()
-        if remaining <= 5:
-            errors.append("total budget exhausted")
+        if remaining <= PROBE_TIMEOUT_S / 2:
+            _push_error(errors, "total budget exhausted")
             break
-        timeout = min(ATTEMPT_TIMEOUT_S, remaining)
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                capture_output=True,
-                text=True,
-                timeout=timeout,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
+
+        # Step 1: cheap probe until the tunnel answers with a live TPU.
+        probe_lines, probe_err = _run_child(
+            "--probe", min(PROBE_TIMEOUT_S, remaining)
+        )
+        probe = next((p for p in probe_lines if p.get("ok")), None)
+        if probe is not None and not allow_cpu and (
+            probe.get("backend") != "tpu"
+        ):
+            probe_err = (
+                f"backend is {probe.get('backend')!r}, not tpu "
+                "(CPU fallback — tunnel likely UNAVAILABLE)"
             )
-        except subprocess.TimeoutExpired:
-            errors.append(f"attempt {attempt + 1}: timed out after {timeout:.0f}s")
-        else:
-            result = None
-            for line in reversed(proc.stdout.splitlines()):
-                try:
-                    candidate = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(candidate, dict) and "ok" in candidate:
-                    result = candidate
-                    break
-            if result and result.get("ok"):
-                _emit(float(result["value"]), extras=result.get("extras"))
-                return 0
-            if result:
-                errors.append(f"attempt {attempt + 1}: {result.get('error', '?')}")
-            else:
-                tail = (proc.stderr or proc.stdout or "").strip()[-300:]
-                errors.append(
-                    f"attempt {attempt + 1}: child rc={proc.returncode}, tail={tail!r}"
+            probe = None
+        if probe is None:
+            _push_error(errors, f"probe: {probe_err or 'no output'}")
+            sleep_s = min(
+                PROBE_BACKOFF_S, max(0.0, deadline - time.monotonic())
+            )
+            if sleep_s > 0:
+                time.sleep(sleep_s)
+            continue
+        merged.setdefault("device_kind", probe.get("device_kind"))
+        merged.setdefault("n_devices", probe.get("n_devices"))
+
+        # Step 2: one measurement attempt.  After a headline-less timeout
+        # or a suspect (divergent-GN, uncorrected) headline, disable the
+        # GroupNorm kernel for the retry.
+        remaining = deadline - time.monotonic()
+        if remaining <= min(30.0, ATTEMPT_TIMEOUT_S / 2):
+            _push_error(errors, "total budget exhausted before attempt")
+            break
+        attempt += 1
+        env = dict(os.environ, CLOUD_TPU_GN_KERNEL="0") if force_gn_off else None
+        lines, err = _run_child(
+            "--child", min(ATTEMPT_TIMEOUT_S, remaining - 5), env=env
+        )
+        headline = None
+        headline_used_kernel = False
+        gn_diverged = False
+        for entry in lines:
+            if entry.get("phase") == "resnet" and entry.get("ok"):
+                headline = float(entry["value"])
+                extras = entry.get("extras") or {}
+                headline_used_kernel = bool(
+                    extras.get("group_norm_kernel_used")
                 )
-        sleep_s = min(BACKOFF_BASE_S * (2**attempt), max(0.0, deadline - time.monotonic()))
-        if attempt + 1 < MAX_ATTEMPTS and sleep_s > 0:
+            if entry.get("phase") == "group_norm" and not entry.get("ok"):
+                gn_diverged = True
+            for key, value in (entry.get("extras") or {}).items():
+                # A later None ("not exercised", e.g. the GN gate skipped
+                # on a kernel-off retry) must not mask an earlier real
+                # result (e.g. the divergence that caused that retry).
+                if value is None and merged.get(key) is not None:
+                    continue
+                merged[key] = value
+            if not entry.get("ok") and entry.get("error"):
+                _push_error(errors, f"{entry['phase']}: {entry['error'][:300]}")
+        if headline is not None and gn_diverged and headline_used_kernel:
+            # The gate proved the kernel wrong and no corrected line
+            # superseded the kernel-path number (a corrected line carries
+            # group_norm_kernel_used=False): the value is untrustworthy.
+            _push_error(
+                errors,
+                f"attempt {attempt}: headline used divergent GN kernel and "
+                "no corrected re-measure arrived; retrying with kernel off",
+            )
+            headline = None
+            force_gn_off = True
+        elif headline is not None:
+            if err:
+                _push_error(
+                    errors, f"attempt {attempt}: {err} (headline salvaged)"
+                )
+            break
+        else:
+            _push_error(
+                errors,
+                f"attempt {attempt}: no headline ({err or 'child died early'})",
+            )
+            force_gn_off = True
+        sleep_s = min(ATTEMPT_BACKOFF_S, max(0.0, deadline - time.monotonic()))
+        if sleep_s > 0:
             time.sleep(sleep_s)
 
-    _emit(0.0, error="; ".join(errors) or "no attempts ran")
+    if headline is not None:
+        _emit(headline, extras=merged,
+              error="; ".join(errors) if errors else "")
+        return 0
+    _emit(0.0, extras=merged, error="; ".join(errors) or "no attempts ran")
     return 1
 
 
 if __name__ == "__main__":
+    if "--probe" in sys.argv:
+        sys.exit(_probe_main())
     if "--child" in sys.argv:
         sys.exit(_child_main())
     sys.exit(main())

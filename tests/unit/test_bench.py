@@ -1,0 +1,266 @@
+"""Unit tests for bench.py's survivability contract (parent-side logic).
+
+The driver's perf artifact depends entirely on the parent process
+surviving a hung tunnel: cheap probe retries, headline-first salvage from
+a timed-out child's partial stdout, and the GroupNorm-disable retry.  The
+children are faked by monkeypatching ``subprocess.run`` — round 3 proved
+the failure mode is real (BENCH_r03.json recorded 0.0 after three 420 s
+timeouts), so the parent logic gets real coverage.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    """Import bench.py as a module with a tiny test budget."""
+    monkeypatch.setenv("CLOUD_TPU_BENCH_TOTAL_BUDGET", "30")
+    monkeypatch.setenv("CLOUD_TPU_BENCH_PROBE_TIMEOUT", "5")
+    monkeypatch.setenv("CLOUD_TPU_BENCH_ATTEMPT_TIMEOUT", "10")
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    module.PROBE_BACKOFF_S = 0.01
+    module.ATTEMPT_BACKOFF_S = 0.0
+    return module
+
+
+def _proc(stdout, rc=0):
+    return subprocess.CompletedProcess(
+        args=[], returncode=rc, stdout=stdout, stderr=""
+    )
+
+
+def _lines(*dicts):
+    return "".join(json.dumps(d) + "\n" for d in dicts)
+
+
+PROBE_OK = {"phase": "probe", "ok": True, "n_devices": 1,
+            "device_kind": "TPU v5 lite", "backend": "tpu"}
+RESNET_OK = {"phase": "resnet", "ok": True, "value": 171.4,
+             "extras": {"mfu": 0.091, "group_norm_kernel_used": True}}
+
+
+def _emitted(capsys):
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1])
+
+
+def test_happy_path_single_line(bench, monkeypatch, capsys):
+    calls = []
+
+    def fake_run(argv, **kwargs):
+        calls.append((argv[-1], kwargs.get("env")))
+        if "--probe" in argv:
+            return _proc(_lines(PROBE_OK))
+        return _proc(_lines(
+            RESNET_OK,
+            {"phase": "bert", "ok": True, "extras": {"bert_mfu": 0.40}},
+        ))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.main() == 0
+    record = _emitted(capsys)
+    assert record["value"] == 171.4
+    assert record["vs_baseline"] == pytest.approx(171.4 / 162.74, abs=1e-3)
+    assert record["bert_mfu"] == 0.40
+    assert record["device_kind"] == "TPU v5 lite"
+    assert "error" not in record
+    assert [mode for mode, _ in calls] == ["--probe", "--child"]
+
+
+def test_headline_salvaged_from_timed_out_child(bench, monkeypatch, capsys):
+    """A child killed mid-run still yields the headline it printed."""
+
+    def fake_run(argv, *, timeout, **kwargs):
+        if "--probe" in argv:
+            return _proc(_lines(PROBE_OK))
+        # Partial stdout arrives as BYTES on TimeoutExpired (observed
+        # even under text=True) — the parent must decode defensively.
+        raise subprocess.TimeoutExpired(
+            argv, timeout, output=_lines(RESNET_OK).encode()
+        )
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.main() == 0
+    record = _emitted(capsys)
+    assert record["value"] == 171.4
+    assert "headline salvaged" in record["error"]
+
+
+def test_probe_retries_instead_of_burning_attempts(bench, monkeypatch, capsys):
+    """While the tunnel hangs, only cheap probes run; once it answers, the
+    measurement child goes out."""
+    state = {"probes": 0}
+
+    def fake_run(argv, *, timeout, **kwargs):
+        if "--probe" in argv:
+            state["probes"] += 1
+            if state["probes"] < 3:
+                raise subprocess.TimeoutExpired(argv, timeout)
+            return _proc(_lines(PROBE_OK))
+        return _proc(_lines(RESNET_OK))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.main() == 0
+    record = _emitted(capsys)
+    assert record["value"] == 171.4
+    assert state["probes"] == 3
+    assert record["error"].count("probe:") == 2
+
+
+def test_gn_kernel_disabled_after_headline_less_timeout(bench, monkeypatch,
+                                                        capsys):
+    """A headline-less timeout retries with CLOUD_TPU_GN_KERNEL=0."""
+    envs = []
+
+    def fake_run(argv, *, timeout, **kwargs):
+        if "--probe" in argv:
+            return _proc(_lines(PROBE_OK))
+        envs.append(kwargs.get("env"))
+        if len(envs) == 1:
+            raise subprocess.TimeoutExpired(argv, timeout)  # nothing printed
+        return _proc(_lines(
+            {"phase": "resnet", "ok": True, "value": 150.0,
+             "extras": {"group_norm_kernel_used": False}},
+        ))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.main() == 0
+    record = _emitted(capsys)
+    assert record["value"] == 150.0
+    assert envs[0] is None
+    assert envs[1]["CLOUD_TPU_GN_KERNEL"] == "0"
+
+
+def test_corrected_headline_supersedes(bench, monkeypatch, capsys):
+    """When the GN gate diverges the child re-measures; last line wins."""
+
+    def fake_run(argv, **kwargs):
+        if "--probe" in argv:
+            return _proc(_lines(PROBE_OK))
+        return _proc(_lines(
+            RESNET_OK,
+            {"phase": "group_norm", "ok": False,
+             "extras": {"group_norm_kernel_ok": False}},
+            {"phase": "resnet", "ok": True, "value": 149.0,
+             "corrected": True,
+             "extras": {"group_norm_kernel_used": False}},
+        ))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.main() == 0
+    record = _emitted(capsys)
+    assert record["value"] == 149.0
+    assert record["group_norm_kernel_ok"] is False
+
+
+def test_total_failure_emits_structured_zero(bench, monkeypatch, capsys):
+    """A permanently hung tunnel still produces one diagnosable line,
+    with the error trail bounded (no unbounded accumulation)."""
+    monkeypatch.setattr(bench, "TOTAL_BUDGET_S", 1.5)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT_S", 1.0)
+
+    def fake_run(argv, *, timeout, **kwargs):
+        raise subprocess.TimeoutExpired(argv, timeout)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.main() == 1
+    record = _emitted(capsys)
+    assert record["value"] == 0.0
+    assert record["vs_baseline"] == 0.0
+    assert "probe" in record["error"]
+    assert len(record["error"]) <= 2000
+
+
+def test_cpu_fallback_probe_rejected(bench, monkeypatch, capsys):
+    """An UNAVAILABLE tunnel makes jax fall back to CPU with only a
+    warning; a CPU 'headline' must never become the TPU number."""
+    monkeypatch.setattr(bench, "TOTAL_BUDGET_S", 1.5)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT_S", 1.0)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    children = []
+
+    def fake_run(argv, **kwargs):
+        if "--probe" in argv:
+            return _proc(_lines({**PROBE_OK, "backend": "cpu",
+                                 "device_kind": "cpu"}))
+        children.append(argv)
+        return _proc(_lines(RESNET_OK))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.main() == 1
+    record = _emitted(capsys)
+    assert record["value"] == 0.0
+    assert "not tpu" in record["error"]
+    assert not children  # never burned a measurement attempt
+
+
+def test_suspect_headline_retried_with_kernel_off(bench, monkeypatch, capsys):
+    """Gate diverged + no corrected line => the kernel-path headline is
+    rejected and the retry runs with CLOUD_TPU_GN_KERNEL=0."""
+    envs = []
+
+    def fake_run(argv, **kwargs):
+        if "--probe" in argv:
+            return _proc(_lines(PROBE_OK))
+        envs.append(kwargs.get("env"))
+        if len(envs) == 1:
+            # Kernel-path headline, gate divergence, then the child dies
+            # before the corrected re-measure prints.
+            return _proc(_lines(
+                RESNET_OK,
+                {"phase": "group_norm", "ok": False,
+                 "extras": {"group_norm_kernel_ok": False}},
+            ), rc=1)
+        return _proc(_lines(
+            {"phase": "resnet", "ok": True, "value": 148.0,
+             "extras": {"group_norm_kernel_used": False}},
+        ))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.main() == 0
+    record = _emitted(capsys)
+    assert record["value"] == 148.0
+    assert envs[1]["CLOUD_TPU_GN_KERNEL"] == "0"
+    assert "divergent GN kernel" in record["error"]
+
+
+def test_child_runs_headline_before_gates():
+    """Static order check: the child measures ResNet before any gate or
+    context phase (VERDICT r3: the GN gate used to run first and a Mosaic
+    hang there forfeited the headline)."""
+    src = open(os.path.join(REPO, "bench.py")).read()
+    child = src[src.index("def _child_main"):]
+    assert child.index("_measure_resnet(extras)") < child.index(
+        "_check_group_norm"
+    )
+    assert child.index("_measure_resnet(extras)") < child.index(
+        "_check_flash_attention"
+    )
+
+
+def test_probe_child_runs_real_probe_on_cpu():
+    """End-to-end: the probe child actually executes (CPU backend)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--probe"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["phase"] == "probe" and line["ok"] is True
+    assert line["n_devices"] >= 1
