@@ -99,28 +99,50 @@ def save_pretrained(directory: str, params: Any, config: Any) -> None:
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
     config_json = _config_to_json(config)
-    params_dir = os.path.join(directory, "params")
-    tmp_dir = params_dir + ".saving"
-    # Durability ordering: write the NEW params to a temp dir first, swap
-    # them in only once fully saved, and write config.json LAST — a
-    # failure mid-save (disk full, kill) must leave either the old bundle
-    # intact or the new one complete, never a config-only shell.  (The
-    # swap also handles re-export: orbax silently declines to re-save an
-    # existing step, which would pair a new config with old params.)
-    if os.path.exists(tmp_dir):
-        shutil.rmtree(tmp_dir)
-    manager = CheckpointManager(tmp_dir, max_to_keep=1)
+    bundle_dir = os.path.join(directory, "bundle")
+    staging = bundle_dir + ".saving"
+    retired = bundle_dir + ".old"
+    # Durability: the (config, params) PAIR is staged as one directory
+    # and swapped in whole, so no kill point can pair new params with a
+    # stale config (or leave a config-only shell).  States on the way:
+    # old bundle intact -> old retired + new staged (both complete; no
+    # active bundle for one rename's width, a clean load *failure*, not
+    # an inconsistent load) -> new bundle live.  (The swap also handles
+    # re-export: orbax silently declines to re-save an existing step,
+    # which would otherwise ship old weights under a new config.)
+    for leftover in (staging, retired):
+        if os.path.exists(leftover):
+            shutil.rmtree(leftover)
+    os.makedirs(staging)
+    manager = CheckpointManager(os.path.join(staging, "params"),
+                                max_to_keep=1)
     try:
         if not manager.save(0, params):
-            raise RuntimeError(f"orbax declined to save params to {tmp_dir}")
+            raise RuntimeError(f"orbax declined to save params to {staging}")
         manager.wait()
     finally:
         manager.close()
-    if os.path.exists(params_dir):
-        shutil.rmtree(params_dir)
-    os.rename(tmp_dir, params_dir)
-    with open(os.path.join(directory, "config.json"), "w") as f:
+    with open(os.path.join(staging, "config.json"), "w") as f:
         json.dump(config_json, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    if os.path.exists(bundle_dir):
+        os.rename(bundle_dir, retired)
+    os.rename(staging, bundle_dir)
+    shutil.rmtree(retired, ignore_errors=True)
+    # Migrating a pre-atomic-swap directory: the old top-level params/
+    # is now superseded — leaving it would waste a copy of the weights
+    # AND let the legacy load fallback resurrect stale params if bundle/
+    # ever goes missing.
+    legacy_params = os.path.join(directory, "params")
+    if os.path.isdir(legacy_params):
+        shutil.rmtree(legacy_params, ignore_errors=True)
+    # Top-level config.json is a human-readable convenience copy (the
+    # loader prefers the in-bundle one); refresh it last, atomically.
+    tmp_config = os.path.join(directory, "config.json.tmp")
+    with open(tmp_config, "w") as f:
+        json.dump(config_json, f, indent=2, sort_keys=True)
+    os.replace(tmp_config, os.path.join(directory, "config.json"))
 
 
 def load_pretrained(
@@ -139,7 +161,29 @@ def load_pretrained(
     from cloud_tpu.training.checkpoint import CheckpointManager
 
     directory = os.path.abspath(directory)
-    with open(os.path.join(directory, "config.json")) as f:
+    # The swapped-as-one-unit bundle/ dir holds the authoritative
+    # (config, params) pair; the top-level config.json is a convenience
+    # copy.  Bundles written before the atomic-swap layout kept params/
+    # and config.json at the top level — still readable.
+    bundle_dir = os.path.join(directory, "bundle")
+    if os.path.isdir(bundle_dir):
+        config_path = os.path.join(bundle_dir, "config.json")
+        params_root = os.path.join(bundle_dir, "params")
+    else:
+        # Legacy fallback is only legitimate when no atomic-swap save
+        # ever ran here: if save leftovers exist, bundle/ is missing
+        # because a save was interrupted mid-swap — fail loudly instead
+        # of silently pairing whatever legacy files remain.
+        for leftover in ("bundle.saving", "bundle.old"):
+            if os.path.exists(os.path.join(directory, leftover)):
+                raise RuntimeError(
+                    f"{directory} has an interrupted save ({leftover} "
+                    "present, bundle/ missing); recover by renaming the "
+                    "complete one back to 'bundle'"
+                )
+        config_path = os.path.join(directory, "config.json")
+        params_root = os.path.join(directory, "params")
+    with open(config_path) as f:
         obj = json.load(f)
     config = _config_from_json(obj)
     if template is None:
@@ -157,9 +201,7 @@ def load_pretrained(
                                            sharding=sharding),
             template,
         )
-    manager = CheckpointManager(
-        os.path.join(directory, "params"), max_to_keep=1
-    )
+    manager = CheckpointManager(params_root, max_to_keep=1)
     try:
         params = manager.restore(0, template=template)
     finally:

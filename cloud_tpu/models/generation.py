@@ -270,7 +270,10 @@ def generate(
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     b, t_prompt = prompt_tokens.shape
-    prompt_lens = prompt_lens.astype(jnp.int32)
+    # Documented domain is 1 <= len <= T_prompt; out-of-range lengths
+    # would make last_idx negative (gather/scatter wrap silently under
+    # jit) — clamp rather than corrupt.
+    prompt_lens = jnp.clip(prompt_lens.astype(jnp.int32), 1, t_prompt)
     if max_new_tokens == 0:
         cols = jnp.arange(t_prompt)[None, :]
         return {
@@ -441,7 +444,9 @@ def beam_search(
     b, t_prompt = prompt_tokens.shape
     k = num_beams
     s = t_prompt + max_new_tokens
-    prompt_lens = prompt_lens.astype(jnp.int32)
+    # Same clamp as generate(): out-of-domain lengths index out of range
+    # silently under jit.
+    prompt_lens = jnp.clip(prompt_lens.astype(jnp.int32), 1, t_prompt)
     vocab = config.vocab_size
     neg_inf = jnp.float32(-1e30)
 

@@ -1,10 +1,20 @@
-"""Thin named-axis collective helpers for use inside ``shard_map``.
+"""Named-axis collective algorithms for use inside ``shard_map``.
 
-XLA inserts collectives automatically for pjit-sharded code; these wrappers
-exist for the explicitly-scheduled paths (ring attention, pipeline) and for
-readability at call sites.  All take mesh axis names, never device ids —
-the TPU-native replacement for the reference's NCCL/gRPC CollectiveOps
-backends (SURVEY.md §2.6), which lived inside tf.distribute.
+XLA inserts collectives automatically for pjit-sharded code; this module
+is for the explicitly-scheduled paths.  Two kinds of content:
+
+* thin named wrappers over ``lax`` collectives (readability at the ring
+  attention / pipeline call sites, and the seam where a future backend
+  tweak lands once);
+* real algorithms XLA does NOT produce on its own: the bandwidth-optimal
+  two-level all-reduce for multi-slice meshes
+  (:func:`hierarchical_all_reduce_sum`), precision-safe gradient
+  synchronization (:func:`grad_sync`), and the sequence<->head
+  re-sharding all-to-all (:func:`all_to_all_seq_heads`).
+
+All take mesh axis names, never device ids — the TPU-native replacement
+for the reference's NCCL/gRPC CollectiveOps backends (SURVEY.md §2.6),
+which lived inside tf.distribute.
 """
 
 from __future__ import annotations
@@ -69,3 +79,82 @@ def broadcast_from(x, axis: str, *, root: int = 0):
 def host_local_mean(tree):
     """jnp mean of a pytree across all devices outside shard_map (jit-level)."""
     return jax.tree_util.tree_map(jnp.mean, tree)
+
+
+def hierarchical_all_reduce_sum(x, *, ici_axis: str, dcn_axis: str,
+                                scatter_dim: int = 0):
+    """Two-level all-reduce for multi-slice meshes: reduce-scatter over
+    the fast in-slice links, all-reduce the 1/n_ici-sized shard across
+    slices, then all-gather back over ICI.
+
+    A flat ``psum`` over both axes moves the FULL tensor across DCN; this
+    decomposition moves ``1/ici_size`` of it — the standard bandwidth-
+    optimal schedule when the outer network is the bottleneck (each DCN
+    link carries only the shard its ICI group owns).  Use for gradient
+    sync on ``dcn_sizes``-split meshes (``MeshSpec.dcn_axes``); for
+    single-slice meshes plain :func:`all_reduce_sum` is simpler and XLA
+    already schedules it well.
+
+    ``scatter_dim`` must divide evenly by the ICI axis size.
+    """
+    n = lax.axis_size(ici_axis)
+    if x.shape[scatter_dim] % n:
+        # Indivisible shapes can't scatter; correctness beats bandwidth.
+        return lax.psum(x, (ici_axis, dcn_axis))
+    shard = lax.psum_scatter(
+        x, ici_axis, scatter_dimension=scatter_dim, tiled=True
+    )
+    shard = lax.psum(shard, dcn_axis)
+    return lax.all_gather(shard, ici_axis, axis=scatter_dim, tiled=True)
+
+
+def grad_sync(grads, axis: AxisNames, *, mean: bool = True,
+              accum_dtype=jnp.float32):
+    """Synchronize a gradient pytree across ``axis`` with precision-safe
+    accumulation: bf16/fp16 leaves are upcast to ``accum_dtype`` for the
+    reduction and cast back after.
+
+    On large rings a bf16 psum loses low-order bits at every add (the
+    reduction runs in the wire dtype); mixed-precision recipes therefore
+    accumulate in f32.  Leaves already >= ``accum_dtype`` wide pass
+    through unchanged.
+    """
+    reduce = lax.pmean if mean else lax.psum
+
+    def sync_leaf(g):
+        dtype = g.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and (
+            jnp.finfo(dtype).bits < jnp.finfo(accum_dtype).bits
+        ):
+            return reduce(g.astype(accum_dtype), axis).astype(dtype)
+        return reduce(g, axis)
+
+    return jax.tree_util.tree_map(sync_leaf, grads)
+
+
+def all_to_all_seq_heads(x, axis: str, *, seq_dim: int = 1,
+                         heads_dim: int = 2, to_heads: bool = True):
+    """Re-shard attention activations between sequence-parallel and
+    head-parallel layouts with one all-to-all (the Ulysses pattern).
+
+    With ``to_heads=True`` an input sharded over sequence
+    (``[B, T/n, H, D]`` per rank) becomes sharded over heads
+    (``[B, T, H/n, D]``): each rank keeps every position for its own
+    head group, which lets attention run WITHOUT ring hops; the inverse
+    (``to_heads=False``) restores sequence sharding for the surrounding
+    feed-forward.  Requires the global head count to divide by the axis
+    size (ring attention covers the indivisible cases).
+    """
+    if to_heads:
+        split, concat = heads_dim, seq_dim
+    else:
+        split, concat = seq_dim, heads_dim
+    n = lax.axis_size(axis)
+    if x.shape[split] % n:
+        raise ValueError(
+            f"all_to_all split dim {split} (size {x.shape[split]}) must "
+            f"divide by axis {axis!r} size {n}"
+        )
+    return lax.all_to_all(
+        x, axis, split_axis=split, concat_axis=concat, tiled=True
+    )

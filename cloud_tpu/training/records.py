@@ -228,10 +228,13 @@ def _scan_records_native(f, path: str, verify: bool):
                 eof = True
         pos = 0
         # from_buffer: a pointer into the bytearray, no copy.  The buffer
-        # is not resized while scanning this fill.
-        base = (
-            ctypes.addressof(ctypes.c_char.from_buffer(buf)) if buf else 0
-        )
+        # is not resized while scanning this fill.  The export object is
+        # held in a named variable and dropped explicitly below — the
+        # tail-trim resize would raise BufferError while any export is
+        # alive, and relying on CPython refcounting to collect an
+        # anonymous temporary is not a portable guarantee.
+        anchor = ctypes.c_char.from_buffer(buf) if buf else None
+        base = ctypes.addressof(anchor) if anchor is not None else 0
         # One memoryview per fill, released before the tail-trim below (a
         # live export blocks bytearray resizing); slicing the view keeps
         # payload extraction at ONE copy instead of bytearray-slice + bytes.
@@ -258,6 +261,7 @@ def _scan_records_native(f, path: str, verify: bool):
         finally:
             if view is not None:
                 view.release()
+            del anchor  # drop the ctypes buffer export before resizing
         if pos:
             del buf[:pos]  # keep only the partial tail
         if eof:

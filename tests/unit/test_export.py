@@ -77,3 +77,75 @@ class TestRoundTrip:
     def test_unknown_family_rejected(self, tmp_path):
         with pytest.raises(ValueError, match="unknown model family"):
             export.save_pretrained(str(tmp_path / "x"), {}, object())
+
+
+class TestBundleLayout:
+    def test_atomic_bundle_layout_and_convenience_copy(self, tmp_path):
+        """The authoritative pair lives in bundle/ (swapped as one unit);
+        a human-readable config.json copy sits at the top level."""
+        import json
+        import os
+
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        d = tmp_path / "m"
+        export.save_pretrained(str(d), params, cfg)
+        assert (d / "bundle" / "config.json").is_file()
+        assert (d / "bundle" / "params").is_dir()
+        assert (d / "config.json").is_file()
+        with open(d / "bundle" / "config.json") as f:
+            inner = json.load(f)
+        with open(d / "config.json") as f:
+            outer = json.load(f)
+        assert inner == outer
+        assert not os.path.exists(d / "bundle.saving")
+        assert not os.path.exists(d / "bundle.old")
+
+    def test_legacy_layout_still_loads(self, tmp_path):
+        """Bundles written before the atomic-swap layout (params/ and
+        config.json at the top level) remain readable."""
+        import shutil
+
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        d = tmp_path / "m"
+        export.save_pretrained(str(d), params, cfg)
+        legacy = tmp_path / "legacy"
+        legacy.mkdir()
+        shutil.copytree(d / "bundle" / "params", legacy / "params")
+        shutil.copy(d / "bundle" / "config.json", legacy / "config.json")
+        loaded, cfg2 = export.load_pretrained(str(legacy))
+        assert cfg2 == cfg
+        _assert_trees_equal(loaded, params)
+
+    def test_migration_removes_stale_legacy_params(self, tmp_path):
+        """Re-exporting over a legacy-layout directory must not leave the
+        old top-level params/ for the fallback to resurrect."""
+        import os
+        import shutil
+
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        p_old = transformer.init(jax.random.PRNGKey(0), cfg)
+        p_new = transformer.init(jax.random.PRNGKey(1), cfg)
+        d = tmp_path / "m"
+        export.save_pretrained(str(d), p_old, cfg)
+        # Rewrite as legacy layout.
+        shutil.move(str(d / "bundle" / "params"), str(d / "params"))
+        shutil.rmtree(d / "bundle")
+        export.save_pretrained(str(d), p_new, cfg)
+        assert not os.path.exists(d / "params")
+        loaded, _ = export.load_pretrained(str(d))
+        _assert_trees_equal(loaded, p_new)
+
+    def test_interrupted_swap_fails_loudly(self, tmp_path):
+        """bundle/ missing + save leftovers present => explicit error,
+        never a silent legacy-fallback load of stale files."""
+        import os
+
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        d = tmp_path / "m"
+        export.save_pretrained(str(d), params, cfg)
+        os.rename(d / "bundle", d / "bundle.old")  # mid-swap kill state
+        with pytest.raises(RuntimeError, match="interrupted save"):
+            export.load_pretrained(str(d))

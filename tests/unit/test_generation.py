@@ -380,3 +380,51 @@ class TestShardedGeneration:
                     jnp.full((2,), 4, jnp.int32), config,
                     max_new_tokens=2, rules=rules, mesh=mesh,
                 )
+
+
+class TestPromptLenValidation:
+    """Out-of-domain prompt_lens (0 or > T_prompt) are clamped instead of
+    silently indexing out of range (ADVICE r3: a 0 length made last_idx
+    negative and stitched sequences out of range)."""
+
+    def test_zero_and_oversized_lens_clamp(self):
+        config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(0)
+        b, t_prompt, n_new = 3, 6, 4
+        prompt = rng.integers(1, 255, (b, t_prompt)).astype(np.int32)
+        bad = jnp.asarray([0, 99, 3], jnp.int32)
+        clamped = jnp.asarray([1, t_prompt, 3], jnp.int32)
+
+        got_bad = generation.generate(
+            params, jnp.asarray(prompt), bad, config,
+            max_new_tokens=n_new,
+            sample=generation.SampleConfig(temperature=0.0),
+        )
+        got_ok = generation.generate(
+            params, jnp.asarray(prompt), clamped, config,
+            max_new_tokens=n_new,
+            sample=generation.SampleConfig(temperature=0.0),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_bad["tokens"]), np.asarray(got_ok["tokens"])
+        )
+
+    def test_beam_search_clamps_too(self):
+        config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(1, 255, (2, 5)).astype(np.int32)
+        bad = jnp.asarray([0, 7], jnp.int32)
+        clamped = jnp.asarray([1, 5], jnp.int32)
+        got_bad = generation.beam_search(
+            params, jnp.asarray(prompt), bad, config,
+            max_new_tokens=3, num_beams=2,
+        )
+        got_ok = generation.beam_search(
+            params, jnp.asarray(prompt), clamped, config,
+            max_new_tokens=3, num_beams=2,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_bad["tokens"]), np.asarray(got_ok["tokens"])
+        )

@@ -198,3 +198,127 @@ class TestCollectives:
             )
         )(x)
         np.testing.assert_allclose(np.asarray(out).ravel(), [3.0] * 8)
+
+
+class TestCollectiveAlgorithms:
+    """The non-trivial collectives: hierarchical reduce, precision-safe
+    grad sync, and the Ulysses seq<->heads all-to-all."""
+
+    def test_hierarchical_all_reduce_matches_flat_psum(self):
+        from jax import shard_map
+
+        mesh = parallel.MeshSpec({"dp": 4, "fsdp": 2}).build()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 6)).astype(np.float32)
+
+        def flat(v):
+            return collectives.all_reduce_sum(v, ("fsdp", "dp"))
+
+        def hier(v):
+            return collectives.hierarchical_all_reduce_sum(
+                v, ici_axis="fsdp", dcn_axis="dp"
+            )
+
+        kwargs = dict(
+            mesh=mesh,
+            in_specs=PartitionSpec(("dp", "fsdp")),
+            out_specs=PartitionSpec(("dp", "fsdp")),
+        )
+        from jax import shard_map as _sm
+        want = jax.jit(_sm(flat, **kwargs))(x)
+        got = jax.jit(_sm(hier, **kwargs))(x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6
+        )
+
+    def test_hierarchical_all_reduce_indivisible_falls_back(self):
+        from jax import shard_map
+
+        mesh = parallel.MeshSpec({"dp": 4, "fsdp": 2}).build()
+        # Per-rank shard rows = 3, not divisible by ici size 2.
+        x = np.arange(24 * 5, dtype=np.float32).reshape(24, 5)
+
+        def hier(v):
+            return collectives.hierarchical_all_reduce_sum(
+                v, ici_axis="fsdp", dcn_axis="dp"
+            )
+
+        got = jax.jit(shard_map(
+            hier, mesh=mesh,
+            in_specs=PartitionSpec(("dp", "fsdp")),
+            out_specs=PartitionSpec(("dp", "fsdp")),
+        ))(x)
+        want = np.tile(
+            x.reshape(8, 3, 5).sum(axis=0), (8, 1)
+        )
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_grad_sync_accumulates_low_precision_in_f32(self):
+        from jax import shard_map
+
+        mesh = parallel.MeshSpec({"dp": 8}).build()
+        # One big value on rank 0, small increments elsewhere: a bf16
+        # running sum swallows the increments (1024 + 1 -> 1024 in bf16),
+        # f32 accumulation keeps them.
+        vals = np.array([1024.0] + [1.0] * 7, np.float32).reshape(8, 1)
+        grads = {"w": jnp.asarray(vals, jnp.bfloat16)}
+
+        def body(g):
+            return collectives.grad_sync(g, "dp", mean=False)
+
+        out = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=PartitionSpec("dp"),
+            out_specs=PartitionSpec("dp"),
+        ))(grads)
+        w = np.asarray(out["w"].astype(jnp.float32))
+        # f32 sum = 1031 exactly -> nearest bf16 = 1032.  Any bf16-wire
+        # reduction gives less: a running chain saturates at 1024, a
+        # balanced tree reaches 1028 (1024+1 rounds down at spacing 8).
+        assert np.all(w == 1032.0), w
+        assert out["w"].dtype == jnp.bfloat16
+
+    def test_all_to_all_seq_heads_round_trip(self):
+        from jax import shard_map
+
+        mesh = parallel.MeshSpec({"sp": 8}).build()
+        b, t, h, d = 2, 16, 8, 4
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(b, t, h, d)).astype(np.float32)
+
+        def body(v):
+            # v: [B, T/8, H, D] -> to heads [B, T, H/8, D] -> back.
+            heads = collectives.all_to_all_seq_heads(
+                v, "sp", to_heads=True
+            )
+            back = collectives.all_to_all_seq_heads(
+                heads, "sp", to_heads=False
+            )
+            return heads, back
+
+        heads, back = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=PartitionSpec(None, "sp", None, None),
+            out_specs=(
+                PartitionSpec(None, None, "sp", None),
+                PartitionSpec(None, "sp", None, None),
+            ),
+        ))(x)
+        assert np.asarray(heads).shape == (b, t, h, d)
+        np.testing.assert_allclose(np.asarray(back), x, rtol=1e-6)
+
+    def test_all_to_all_rejects_indivisible_heads(self):
+        from jax import shard_map
+
+        mesh = parallel.MeshSpec({"sp": 8}).build()
+        x = np.zeros((2, 16, 6, 4), np.float32)  # 6 heads % 8 != 0
+
+        with pytest.raises(ValueError, match="must\ndivide|must divide"):
+            jax.jit(shard_map(
+                lambda v: collectives.all_to_all_seq_heads(
+                    v, "sp", to_heads=True
+                ),
+                mesh=mesh,
+                in_specs=PartitionSpec(None, "sp", None, None),
+                out_specs=PartitionSpec(None, None, "sp", None),
+            ))(x)
