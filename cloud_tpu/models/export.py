@@ -110,9 +110,17 @@ def save_pretrained(directory: str, params: Any, config: Any) -> None:
     # an inconsistent load) -> new bundle live.  (The swap also handles
     # re-export: orbax silently declines to re-save an existing step,
     # which would otherwise ship old weights under a new config.)
-    for leftover in (staging, retired):
-        if os.path.exists(leftover):
-            shutil.rmtree(leftover)
+    if os.path.exists(staging):
+        shutil.rmtree(staging)
+    if os.path.exists(retired):
+        if not os.path.exists(bundle_dir):
+            # A previous save died between the two swap renames:
+            # bundle.old is the ONLY complete copy.  Complete that swap
+            # (restore it) rather than deleting it up front — if THIS
+            # save also fails, the old weights must still exist.
+            os.rename(retired, bundle_dir)
+        else:
+            shutil.rmtree(retired)
     os.makedirs(staging)
     manager = CheckpointManager(os.path.join(staging, "params"),
                                 max_to_keep=1)
@@ -170,17 +178,18 @@ def load_pretrained(
         config_path = os.path.join(bundle_dir, "config.json")
         params_root = os.path.join(bundle_dir, "params")
     else:
-        # Legacy fallback is only legitimate when no atomic-swap save
-        # ever ran here: if save leftovers exist, bundle/ is missing
-        # because a save was interrupted mid-swap — fail loudly instead
-        # of silently pairing whatever legacy files remain.
-        for leftover in ("bundle.saving", "bundle.old"):
-            if os.path.exists(os.path.join(directory, leftover)):
-                raise RuntimeError(
-                    f"{directory} has an interrupted save ({leftover} "
-                    "present, bundle/ missing); recover by renaming the "
-                    "complete one back to 'bundle'"
-                )
+        # bundle.old + no bundle/ proves a save died BETWEEN the two
+        # swap renames: the legacy files (if any) predate the retired
+        # bundle — fail loudly instead of silently loading them.  A
+        # bundle.saving leftover alone does NOT block the fallback: a
+        # crash during staging (before any swap) leaves the previous
+        # layout fully intact and current.
+        if os.path.exists(os.path.join(directory, "bundle.old")):
+            raise RuntimeError(
+                f"{directory} has an interrupted save (bundle.old "
+                "present, bundle/ missing); recover by renaming "
+                "bundle.old back to 'bundle'"
+            )
         config_path = os.path.join(directory, "config.json")
         params_root = os.path.join(directory, "params")
     with open(config_path) as f:

@@ -30,30 +30,90 @@ import time as _time
 
 
 class MetricsCallback:
-    """Trainer callback feeding the native registry each step/epoch."""
+    """Trainer callback feeding the native registry each step/epoch.
 
-    def __init__(self, prefix: str = "train"):
+    ``Trainer.fit`` installs one automatically (reference parity: the
+    stackdriver exporter shipped TF runtime metrics with zero user code,
+    ``stackdriver_exporter.cc:86-97``) — every training run produces
+    ``train/steps``, ``train/step_time_ms``, ``train/steps_per_sec``,
+    ``train/loss``, and ``train/epochs`` for the exporter to ship.
+
+    Hot-path contract: never force a device sync.  The loss gauge is
+    read with a ONE-STEP LAG — by the time step N ends, step N-1's
+    metrics are materialized on device, so ``float()`` on them returns
+    without stalling the async dispatch pipeline.  Step time is host
+    wall-clock between step dispatches; steps/sec is a windowed gauge
+    (updated every ``window`` steps).
+    """
+
+    def __init__(self, prefix: str = "train", *, window: int = 20):
+        from cloud_tpu.monitoring.metrics import WindowedRate
+
         self.prefix = prefix
+        self._rate = WindowedRate(f"{prefix}/steps_per_sec", window)
         self._last_step_time = None
+        self._lagged_logs = None
+
+    def _record_lagged_loss(self):
+        logs = self._lagged_logs
+        self._lagged_logs = None
+        if not logs or "loss" not in logs:
+            return
+        try:
+            gauge_set(f"{self.prefix}/loss", float(logs["loss"]))
+        except (TypeError, ValueError):
+            pass
 
     def on_train_begin(self, trainer):
-        self._last_step_time = _time.perf_counter()
+        now = _time.perf_counter()
+        self._last_step_time = now
+        self._rate.restart(now)
+        self._lagged_logs = None
+        counter_inc(f"{self.prefix}/runs")
 
-    def on_train_end(self, trainer): ...
-    def on_epoch_begin(self, epoch, trainer): ...
+    def on_train_end(self, trainer):
+        # The final step's loss never got its lagged read; it is
+        # materialized by now (the epoch loop device_get'd the metrics).
+        self._record_lagged_loss()
+
+    def on_epoch_begin(self, epoch, trainer):
+        # Restart both timers: inter-epoch work (validation, epoch-end
+        # callbacks, device_get of epoch metrics) must count neither as
+        # step time nor as steps/sec window time.
+        now = _time.perf_counter()
+        self._last_step_time = now
+        self._rate.restart(now)
 
     def on_step_end(self, step, logs, trainer):
         now = _time.perf_counter()
         if self._last_step_time is not None:
             distribution_record(
-                f"{self.prefix}/step_seconds", now - self._last_step_time
+                f"{self.prefix}/step_time_ms",
+                (now - self._last_step_time) * 1e3,
             )
         self._last_step_time = now
         counter_inc(f"{self.prefix}/steps")
+        self._record_lagged_loss()
+        self._lagged_logs = logs
+        self._rate.add(now)
 
     def on_epoch_end(self, epoch, logs, trainer):
+        # Publish the partial window with the LAST step's timestamp, so
+        # short epochs still produce a rate and validation time is
+        # excluded from it.
+        if self._last_step_time is not None:
+            self._rate.flush(self._last_step_time)
+        counter_inc(f"{self.prefix}/epochs")
         for key, value in logs.items():
-            gauge_set(f"{self.prefix}/{key}", float(value))
+            if key == "loss":
+                # train/loss is the per-step lagged gauge; writing the
+                # epoch MEAN into the same series would make it
+                # alternate between two different quantities.
+                continue
+            try:
+                gauge_set(f"{self.prefix}/{key}", float(value))
+            except (TypeError, ValueError):
+                continue
 
 
 __all__ = [

@@ -160,6 +160,42 @@ def backend() -> str:
 
 # --- module-level API ---
 
+class WindowedRate:
+    """Events/sec gauge over a sliding window of ``window`` events.
+
+    Shared by every throughput producer (trainer steps/sec, records
+    pipeline examples/sec): accumulate counts via :meth:`add`, and the
+    gauge updates each time a window fills; :meth:`flush` publishes a
+    partial window (short runs, end of stream) and restarts timing —
+    call it at natural boundaries (epoch end, stream end) so dead time
+    between them is never counted as event time.
+    """
+
+    def __init__(self, name: str, window: int):
+        self.name = name
+        self.window = max(1, int(window))
+        self._count = 0
+        self._start: Optional[float] = None
+
+    def restart(self, now: float) -> None:
+        """Drop the current window and start timing from ``now``."""
+        self._count = 0
+        self._start = now
+
+    def add(self, now: float, n: int = 1) -> None:
+        if self._start is None:
+            self._start = now
+            return
+        self._count += n
+        if self._count >= self.window:
+            self.flush(now)
+
+    def flush(self, now: float) -> None:
+        if self._count and self._start is not None and now > self._start:
+            gauge_set(self.name, self._count / (now - self._start))
+        self.restart(now)
+
+
 def counter_inc(name: str, delta: int = 1) -> None:
     _get_registry().counter_inc(name, delta)
 

@@ -692,14 +692,43 @@ class RecordDataset:
         yield from buf
 
     def __call__(self) -> Iterator[Dict[str, np.ndarray]]:
-        batch: List[Dict[str, np.ndarray]] = []
-        for example in self._shuffled():
-            batch.append(example)
-            if len(batch) == self.batch_size:
+        # Pipeline throughput producers (default exporter telemetry, like
+        # the trainer's MetricsCallback): per-batch counter bumps are a
+        # ctypes call each — noise against decode cost — and the
+        # examples/sec gauge updates via the shared windowed-rate helper
+        # (one window = 32 batches), with the tail flushed at stream end.
+        from time import perf_counter
+
+        from cloud_tpu.monitoring import metrics as _metrics
+
+        rate = _metrics.WindowedRate(
+            "data/examples_per_sec", 32 * self.batch_size
+        )
+        rate.restart(perf_counter())
+
+        def account(n: int) -> None:
+            _metrics.counter_inc("data/batches")
+            _metrics.counter_inc("data/examples", n)
+            rate.add(perf_counter(), n)
+
+        # account() runs BEFORE each yield and the flush sits in a
+        # finally: a consumer that stops early (steps_per_epoch break,
+        # abandoned prefetch) suspends the generator at the yield and
+        # GCs it — counting after the yield would drop the last batch
+        # and skip the tail flush.
+        try:
+            batch: List[Dict[str, np.ndarray]] = []
+            for example in self._shuffled():
+                batch.append(example)
+                if len(batch) == self.batch_size:
+                    account(self.batch_size)
+                    yield self._collate(batch)
+                    batch = []
+            if batch and not self.drop_remainder:
+                account(len(batch))
                 yield self._collate(batch)
-                batch = []
-        if batch and not self.drop_remainder:
-            yield self._collate(batch)
+        finally:
+            rate.flush(perf_counter())
 
     @staticmethod
     def _collate(examples: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
@@ -834,7 +863,14 @@ def prefetch_to_device(
             return jax.device_put(batch)
         return train_lib.shard_batch(batch, mesh, rules)
 
+    def place_counted(batch):
+        from cloud_tpu.monitoring import metrics as _metrics
+
+        placed = place(batch)
+        _metrics.counter_inc("data/host_to_device_batches")
+        return placed
+
     def factory():
-        return _PrefetchIterator(dataset(), place, size)
+        return _PrefetchIterator(dataset(), place_counted, size)
 
     return factory

@@ -285,6 +285,7 @@ class Trainer:
         if self.state is None:
             raise ValueError("No TrainState; call init_state() or pass state=")
         callbacks = list(callbacks or [])
+        callbacks = self._with_runtime_metrics(callbacks)
         history = History()
         callbacks.append(history)
         self.stop_training = False
@@ -343,3 +344,25 @@ class Trainer:
         import contextlib
 
         return self.mesh if self.mesh is not None else contextlib.nullcontext()
+
+    @staticmethod
+    def _with_runtime_metrics(callbacks: List[Callback]) -> List[Callback]:
+        """Install the default metrics producer (reference parity: runtime
+        metrics export with zero user code, stackdriver_exporter.cc:86-97).
+
+        Every fit() records steps / loss / step-time / epochs into
+        ``monitoring.metrics`` so the exporter always has real series to
+        ship.  Opt out with ``CLOUD_TPU_RUNTIME_METRICS=0``; a user-passed
+        ``MetricsCallback`` (any prefix) suppresses the default one.
+        """
+        import os
+
+        if os.environ.get("CLOUD_TPU_RUNTIME_METRICS", "1") == "0":
+            return callbacks
+        from cloud_tpu import monitoring
+
+        if any(
+            isinstance(cb, monitoring.MetricsCallback) for cb in callbacks
+        ):
+            return callbacks
+        return callbacks + [monitoring.MetricsCallback()]

@@ -149,3 +149,37 @@ class TestBundleLayout:
         os.rename(d / "bundle", d / "bundle.old")  # mid-swap kill state
         with pytest.raises(RuntimeError, match="interrupted save"):
             export.load_pretrained(str(d))
+
+    def test_staging_crash_keeps_legacy_readable(self, tmp_path):
+        """A crash during staging (bundle.saving leftover, no swap ever
+        started) must NOT block reading an intact legacy layout."""
+        import shutil
+
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        d = tmp_path / "m"
+        export.save_pretrained(str(d), params, cfg)
+        # Rewrite as legacy layout with a dead staging dir next to it.
+        shutil.move(str(d / "bundle" / "params"), str(d / "params"))
+        shutil.rmtree(d / "bundle")
+        (d / "bundle.saving").mkdir()
+        loaded, cfg2 = export.load_pretrained(str(d))
+        assert cfg2 == cfg
+        _assert_trees_equal(loaded, params)
+
+    def test_resave_after_interrupted_swap_restores_then_replaces(self, tmp_path):
+        """Re-running save after a mid-swap crash must first complete the
+        old swap (bundle.old is the only copy) — never delete it before
+        the new save is durable."""
+        import os
+
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        p1 = transformer.init(jax.random.PRNGKey(0), cfg)
+        p2 = transformer.init(jax.random.PRNGKey(1), cfg)
+        d = tmp_path / "m"
+        export.save_pretrained(str(d), p1, cfg)
+        os.rename(d / "bundle", d / "bundle.old")  # mid-swap kill state
+        export.save_pretrained(str(d), p2, cfg)
+        assert not os.path.exists(d / "bundle.old")
+        loaded, _ = export.load_pretrained(str(d))
+        _assert_trees_equal(loaded, p2)
