@@ -97,27 +97,16 @@ def _default_rules():
 def _maybe_restore(trainer, state_dir: str) -> bool:
     if state_dir.startswith("gs://") or os.path.isdir(state_dir):
         try:
-            import jax
-            import numpy as np
+            # Shared resume recipe (rng-leaf-tolerant, sharding-aware,
+            # failure = fresh start): training/checkpoint.py.
+            from cloud_tpu.training.checkpoint import (
+                CheckpointManager,
+                resume_trainer_state,
+            )
 
-            from cloud_tpu.training.checkpoint import CheckpointManager
-
-            manager = CheckpointManager(state_dir)
-            if manager.latest_step() is not None:
-                # Restore WITHOUT the rng leaf: a checkpoint written under
-                # the other stochastic setting has a different TrainState
-                # structure there, and a structure mismatch would silently
-                # retrain from scratch via the except below.  The fresh
-                # state's key (or None) carries forward instead.
-                current = trainer.state
-                template = jax.tree_util.tree_map(
-                    np.asarray, current.replace(rng=None)
-                )
-                restored = manager.restore(template=template)
-                trainer.state = restored.replace(rng=current.rng)
-                logger.info("restored checkpoint at step %s",
-                            int(trainer.state.step))
-                return True
+            return resume_trainer_state(
+                trainer, CheckpointManager(state_dir)
+            )
         except Exception:
             logger.exception("could not restore from %s; starting fresh",
                              state_dir)

@@ -287,6 +287,117 @@ def _rollback_nodes(session, parent: str, node_ids: List[str]) -> None:
             logger.exception("rollback of node %s failed", node_id)
 
 
+def supervise_job(
+    job_info: dict,
+    request: dict,
+    *,
+    session: Optional[api_client.GcpApiSession] = None,
+    poll_seconds: float = 30.0,
+    max_restarts: int = 3,
+    should_stop: Optional[Callable[[], bool]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> dict:
+    """Watch a running job's nodes and recreate any that get preempted.
+
+    The reference's recovery story was CAIP job restarts (SURVEY.md §5
+    "recovery is delegated to CAIP job restarts"); this framework owns
+    the node lifecycle, so it owns the restart: a node observed in
+    PREEMPTED/TERMINATED after the job started is deleted (best-effort)
+    and re-created from its original body in ``request`` (the
+    ``build_job_request`` result that deploy_job submitted), then awaited
+    READY again.  The recreated node boots the same startup script, the
+    container re-enters bootstrap, and training resumes from the latest
+    checkpoint (``CheckpointCallback(resume=True)`` / cloud_fit's
+    ``_maybe_restore``) — compute is lost back to the last save, nothing
+    more.
+
+    ``max_restarts`` bounds TOTAL restarts across all nodes; exceeding it
+    raises :class:`ProvisioningError` (the job is likely being preempted
+    faster than it can checkpoint).  Runs until ``should_stop()`` returns
+    True — or until every node has been deleted out from under it
+    (``delete_job`` from anywhere, console teardown), which is the normal
+    end-of-job signal; returns ``{"restarts": {node_id: count}}``.
+    Transient API errors on the state poll are logged and retried next
+    round, never fatal — this loop may run for days.
+    """
+    session = session or api_client.default_session()
+    parent = f"projects/{job_info['project']}/locations/{job_info['zone']}"
+    restarts: Dict[str, int] = {}
+    watching = list(job_info["nodes"])
+    # Nodes whose last recreate FAILED don't exist in the API; a 404 for
+    # them means "retry the recreate", while a 404 for a healthy node
+    # means someone tore it down (job finished) — stop watching it.
+    recreate_pending: set = set()
+
+    def _recreate(node_id: str, why: str) -> None:
+        total = sum(restarts.values())
+        if total >= max_restarts:
+            raise ProvisioningError(
+                f"node {node_id} {why}; restart budget ({max_restarts}) "
+                "exhausted — preemption is outpacing checkpointing"
+            )
+        logger.warning("node %s %s; recreating (restart %d/%d)",
+                       node_id, why, total + 1, max_restarts)
+        restarts[node_id] = restarts.get(node_id, 0) + 1
+        recreate_pending.add(node_id)
+        try:
+            # nodes.delete is an LRO: creating the replacement before the
+            # old node is fully gone gets 409 ALREADY_EXISTS.
+            del_op = session.delete(f"{_TPU_API}/{parent}/nodes/{node_id}")
+            if isinstance(del_op, dict):
+                _await_operation(session, del_op, node_id, sleep=sleep)
+        except (api_client.ApiError, ProvisioningError):
+            logger.info("delete of %s failed (already gone?)", node_id)
+        try:
+            op = session.post(
+                f"{_TPU_API}/{parent}/nodes",
+                body=request["nodes"][node_id],
+                params={"nodeId": node_id},
+            )
+            _await_operation(session, op, node_id, sleep=sleep)
+            _await_node_ready(session, parent, node_id, sleep=sleep)
+            recreate_pending.discard(node_id)
+        except (api_client.ApiError, ProvisioningError):
+            # The replacement died too (preempted while provisioning,
+            # capacity, transient API failure).  The restart is spent;
+            # the next round retries until the budget runs out.
+            logger.warning(
+                "recreated node %s failed to reach READY; retrying",
+                node_id,
+            )
+
+    while not (should_stop and should_stop()):
+        for node_id in list(watching):
+            if should_stop and should_stop():
+                break
+            try:
+                node = session.get(f"{_TPU_API}/{parent}/nodes/{node_id}")
+            except api_client.ApiError as exc:
+                if exc.status == 404:
+                    if node_id in recreate_pending:
+                        _recreate(node_id, "missing after failed recreate")
+                    else:
+                        logger.info(
+                            "node %s deleted externally; done watching it",
+                            node_id,
+                        )
+                        watching.remove(node_id)
+                else:
+                    logger.warning("state poll of %s failed (%s); will "
+                                   "retry", node_id, exc)
+                continue
+            state = node.get("state")
+            if state in ("PREEMPTED", "TERMINATED"):
+                _recreate(node_id, state)
+        if not watching:
+            logger.info("all nodes gone; supervision complete")
+            break
+        if should_stop and should_stop():
+            break
+        sleep(poll_seconds)
+    return {"restarts": restarts}
+
+
 def delete_job(job_info: dict,
                session: Optional[api_client.GcpApiSession] = None) -> None:
     """Tear the job's TPU nodes down (the lifecycle the reference delegated

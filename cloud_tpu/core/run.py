@@ -63,6 +63,7 @@ def run(
     service_account: Optional[str] = None,
     parallelism_hints: Optional[planner.ParallelismHints] = None,
     dry_run: bool = False,
+    max_restarts: int = 0,
     _session=None,
     _builder=None,
     **kwargs,
@@ -71,8 +72,14 @@ def run(
 
     Args mirror the reference ``run()`` (run.py:36-131) plus
     ``parallelism_hints`` (mesh axis pins — capability the reference's
-    strategy picker couldn't express) and ``dry_run`` (produce every
-    artifact, submit nothing).  ``_session``/``_builder`` are test seams.
+    strategy picker couldn't express), ``dry_run`` (produce every
+    artifact, submit nothing), and ``max_restarts`` (> 0: stay alive
+    after submission supervising the job — preempted nodes are recreated
+    up to this many times and training resumes from the latest
+    checkpoint; the reference delegated this to CAIP job restarts.
+    Blocking, like ``stream_logs``; if both are set, log streaming wins
+    and supervision never starts).  ``_session``/``_builder`` are test
+    seams.
 
     Returns a RunReport.  In script mode (entry_point=None, run() called
     from the training script itself) the local process exits after
@@ -227,6 +234,16 @@ def run(
     finally:
         for d in temp_dirs:
             shutil.rmtree(d, ignore_errors=True)
+
+    if max_restarts > 0:
+        # After cleanup: supervision may run for the job's whole life and
+        # needs none of the build artifacts.  Returns when the job's
+        # nodes are torn down (delete_job/console) or raises when the
+        # restart budget is exhausted.
+        deploy.supervise_job(
+            job_info, job_request, session=_session,
+            max_restarts=max_restarts,
+        )
 
     if script_mode and not called_from_notebook:
         # Stop local execution of the training script after submitting

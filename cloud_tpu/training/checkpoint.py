@@ -64,14 +64,66 @@ class CheckpointManager:
         self._manager.close()
 
 
+def resume_trainer_state(trainer, manager: CheckpointManager) -> bool:
+    """Restore the latest checkpoint into ``trainer.state`` if it is ahead.
+
+    The ONE shared resume recipe (used by :class:`CheckpointCallback` and
+    cloud_fit's server): restores WITHOUT the rng leaf — a checkpoint
+    written under the other ``stochastic`` setting has a different
+    TrainState structure there, and a structure mismatch would otherwise
+    fail the restore; the fresh state's key (or None) carries forward.
+    The template keeps each leaf's shape/dtype/sharding, so a sharded
+    state restores straight into its mesh layout.  Any restore failure
+    logs and returns False (train from the fresh state) rather than
+    killing the job at startup.
+    """
+    if trainer.state is None:
+        return False
+    latest = manager.latest_step()
+    if latest is None or latest <= int(trainer.state.step):
+        return False
+    current = trainer.state
+    try:
+        import jax
+
+        template = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            ),
+            current.replace(rng=None),
+        )
+        restored = manager.restore(latest, template=template)
+        trainer.state = restored.replace(rng=current.rng)
+        logger.info("resumed from checkpoint step %d", latest)
+        return True
+    except Exception:  # noqa: BLE001 — fresh start beats a dead job
+        logger.exception(
+            "could not restore latest checkpoint (step %s); starting fresh",
+            latest,
+        )
+        return False
+
+
 class CheckpointCallback:
-    """Trainer callback: save every N steps and at train end."""
+    """Trainer callback: save every N steps and at train end.
+
+    ``resume=True`` (default) restores the latest checkpoint into
+    ``trainer.state`` at train begin when one exists AND is ahead of the
+    current state — the preemption-recovery contract: a recreated node
+    re-runs the same script, whose fresh state is at step 0, and training
+    continues from the last save instead of from scratch
+    (``deploy.supervise_job`` docstring).  A fresh run with an empty
+    directory is untouched, so the default is safe.  The restore template
+    is the trainer's own state (same Trainer config => same TrainState
+    structure).
+    """
 
     def __init__(self, directory: str, *, every_n_steps: int = 100,
-                 max_to_keep: int = 3):
+                 max_to_keep: int = 3, resume: bool = True):
         self.directory = directory
         self.every_n_steps = every_n_steps
         self.max_to_keep = max_to_keep
+        self.resume = resume
         self._manager: Optional[CheckpointManager] = None
 
     # Lazily create the manager so the callback object stays cloudpickleable
@@ -89,7 +141,11 @@ class CheckpointCallback:
         state["_manager"] = None
         return state
 
-    def on_train_begin(self, trainer): ...
+    def on_train_begin(self, trainer):
+        if not self.resume or trainer.state is None:
+            return
+        resume_trainer_state(trainer, self._get())
+
     def on_epoch_begin(self, epoch, trainer): ...
 
     def on_step_end(self, step, logs, trainer):

@@ -287,6 +287,168 @@ class TestDeploy:
             )
         assert [c[0] for c in session.calls] == ["POST", "GET", "DELETE"]
 
+    def test_supervise_recreates_preempted_node(self):
+        """VERDICT r3 #3 'done' criterion: READY -> PREEMPTED ->
+        (recreate) -> READY, driven by a fake session."""
+        plan = planner.plan_mesh(chief_config=TPU)
+        request = deploy.build_job_request("img", TPU, 0, plan, job_id="j")
+        job_info = {"job_id": "j", "nodes": list(request["nodes"]),
+                    "project": "p", "zone": "z"}
+        session = FakeSession(responses=[
+            {"state": "READY"},                 # round 1: healthy
+            {"state": "PREEMPTED"},             # round 2: preempted
+            {},                                 # DELETE old node
+            {"name": "ops/r", "done": True},    # POST recreate op
+            {"state": "READY"},                 # await READY
+            {"state": "READY"},                 # round 3: healthy again
+        ])
+        rounds = []
+        result = deploy.supervise_job(
+            job_info, request, session=session,
+            should_stop=lambda: len(rounds) >= 3,
+            sleep=lambda _: rounds.append(1),
+        )
+        assert result["restarts"] == {"j-0": 1}
+        methods = [(c[0], c[1].rsplit("/", 1)[-1]) for c in session.calls]
+        assert ("DELETE", "j-0") in methods
+        recreates = [
+            c for c in session.calls
+            if c[0] == "POST" and c[3] == {"nodeId": "j-0"}
+        ]
+        assert len(recreates) == 1
+        # The recreated node uses the ORIGINAL body (same startup script
+        # -> same rank contract -> bootstrap resumes from checkpoint).
+        assert recreates[0][2] == request["nodes"]["j-0"]
+
+    def test_supervise_restart_budget_exhausted(self):
+        plan = planner.plan_mesh(chief_config=TPU)
+        request = deploy.build_job_request("img", TPU, 0, plan, job_id="j")
+        job_info = {"job_id": "j", "nodes": list(request["nodes"]),
+                    "project": "p", "zone": "z"}
+
+        class AlwaysPreempted(FakeSession):
+            def get(self, url, params=None):
+                self.calls.append(("GET", url, None, params))
+                if "/nodes/" in url:
+                    return {"state": "PREEMPTED"}
+                return {"done": True, "name": "ops/x"}
+
+        session = AlwaysPreempted()
+        with pytest.raises(deploy.ProvisioningError, match="restart budget"):
+            deploy.supervise_job(
+                job_info, request, session=session, max_restarts=2,
+                sleep=lambda _: None,
+            )
+        recreates = [c for c in session.calls if c[0] == "POST"]
+        assert len(recreates) == 2  # two restarts spent, third refused
+
+    def test_supervise_awaits_delete_lro_before_recreate(self):
+        """nodes.delete is an LRO; creating before it completes 409s."""
+        plan = planner.plan_mesh(chief_config=TPU)
+        request = deploy.build_job_request("img", TPU, 0, plan, job_id="j")
+        job_info = {"job_id": "j", "nodes": list(request["nodes"]),
+                    "project": "p", "zone": "z"}
+        session = FakeSession(responses=[
+            {"state": "PREEMPTED"},              # round 1 poll
+            {"name": "ops/del", "done": False},  # DELETE returns LRO
+            {"name": "ops/del", "done": True},   # GET op: delete done
+            {"name": "ops/cr", "done": True},    # POST recreate
+            {"state": "READY"},                  # await READY
+        ])
+        rounds = []
+        deploy.supervise_job(
+            job_info, request, session=session,
+            should_stop=lambda: len(rounds) >= 1,
+            sleep=lambda s: rounds.append(s) if s else None,
+        )
+        methods = [c[0] for c in session.calls]
+        # DELETE, then its op polled via GET, THEN the recreate POST.
+        assert methods.index("DELETE") < methods.index("POST")
+        op_poll = [c for c in session.calls
+                   if c[0] == "GET" and c[1].endswith("ops/del")]
+        assert op_poll, session.calls
+
+    def test_supervise_ends_when_job_torn_down(self):
+        """delete_job from anywhere => all GETs 404 => supervision
+        returns normally instead of polling forever."""
+        plan = planner.plan_mesh(chief_config=TPU)
+        request = deploy.build_job_request("img", TPU, 0, plan, job_id="j")
+        job_info = {"job_id": "j", "nodes": list(request["nodes"]),
+                    "project": "p", "zone": "z"}
+
+        class Gone(FakeSession):
+            def get(self, url, params=None):
+                self.calls.append(("GET", url, None, params))
+                raise api_client.ApiError(404, "not found")
+
+        result = deploy.supervise_job(
+            job_info, request, session=Gone(), sleep=lambda _: None,
+        )
+        assert result["restarts"] == {}
+
+    def test_supervise_retries_recreate_after_404(self):
+        """A failed recreate leaves no node; the next round's 404 must
+        retry the recreate (budget-bounded), not stop watching."""
+        plan = planner.plan_mesh(chief_config=TPU)
+        request = deploy.build_job_request("img", TPU, 0, plan, job_id="j")
+        job_info = {"job_id": "j", "nodes": list(request["nodes"]),
+                    "project": "p", "zone": "z"}
+        session = FakeSession(responses=[
+            {"state": "PREEMPTED"},             # round 1: preempted
+            {},                                 # DELETE (sync fake)
+            {"name": "ops/c1", "done": True,
+             "error": {"code": 8}},             # recreate op FAILS
+        ])
+
+        # Round 2: GET node -> 404 (node never created); retry recreate.
+        orig_get = session.get
+
+        def get(url, params=None):
+            if "/nodes/j-0" in url and not session.responses:
+                session.calls.append(("GET", url, None, params))
+                raise api_client.ApiError(404, "not found")
+            return orig_get(url, params=params)
+
+        session.get = get
+        with pytest.raises(deploy.ProvisioningError, match="restart budget"):
+            deploy.supervise_job(
+                job_info, request, session=session, max_restarts=1,
+                sleep=lambda _: None,
+            )
+        posts = [c for c in session.calls if c[0] == "POST"]
+        assert len(posts) == 1  # budget 1: first recreate spent it
+
+    def test_run_wires_supervision(self, tmp_path, monkeypatch):
+        """run(max_restarts=N) hands the submitted request to the
+        supervisor so recreated nodes reuse the exact submitted bodies."""
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "proj")
+        script = tmp_path / "train.py"
+        script.write_text("pass")
+        calls = {}
+
+        def fake_supervise(job_info, request, *, session, max_restarts):
+            calls["job_info"] = job_info
+            calls["request"] = request
+            calls["max_restarts"] = max_restarts
+            return {"restarts": {}}
+
+        monkeypatch.setattr(deploy, "supervise_job", fake_supervise)
+
+        class FakeBuilder:
+            def get_docker_image(self):
+                return "gcr.io/proj/built:1"
+
+        report = run_lib.run(
+            entry_point=str(script),
+            max_restarts=2,
+            _session=FakeSession(),
+            _builder=FakeBuilder(),
+        )
+        assert report.submitted
+        assert calls["max_restarts"] == 2
+        assert calls["job_info"]["job_id"] == report.job_id
+        assert set(calls["request"]["nodes"]) == set(report.node_requests)
+
     def test_stream_logs_follows_with_cursor(self):
         """VERDICT r1 missing #7: continuous streaming, not one-shot."""
         session = FakeSession(responses=[
