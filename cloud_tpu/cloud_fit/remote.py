@@ -104,8 +104,10 @@ def _maybe_restore(trainer, state_dir: str) -> bool:
                 resume_trainer_state,
             )
 
+            # only_if_ahead=False: a user-uploaded state saved at step
+            # 0 (pretrained weights) must replace the fresh init too.
             return resume_trainer_state(
-                trainer, CheckpointManager(state_dir)
+                trainer, CheckpointManager(state_dir), only_if_ahead=False
             )
         except Exception:
             logger.exception("could not restore from %s; starting fresh",

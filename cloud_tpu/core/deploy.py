@@ -357,13 +357,13 @@ def supervise_job(
             _await_operation(session, op, node_id, sleep=sleep)
             _await_node_ready(session, parent, node_id, sleep=sleep)
             recreate_pending.discard(node_id)
-        except (api_client.ApiError, ProvisioningError):
+        except Exception:  # noqa: BLE001 — the budget raise is earlier
             # The replacement died too (preempted while provisioning,
-            # capacity, transient API failure).  The restart is spent;
-            # the next round retries until the budget runs out.
+            # capacity, transient API/transport failure).  The restart is
+            # spent; the next round retries until the budget runs out.
             logger.warning(
                 "recreated node %s failed to reach READY; retrying",
-                node_id,
+                node_id, exc_info=True,
             )
 
     while not (should_stop and should_stop()):
@@ -386,6 +386,16 @@ def supervise_job(
                     logger.warning("state poll of %s failed (%s); will "
                                    "retry", node_id, exc)
                 continue
+            except Exception as exc:  # noqa: BLE001 — days-long loop:
+                # transport errors (connection reset, auth refresh
+                # hiccup) are not ApiErrors but are just as transient.
+                logger.warning("state poll of %s failed (%s); will retry",
+                               node_id, exc)
+                continue
+            # The node exists: any earlier failed-recreate bookkeeping is
+            # obsolete (e.g. the await timed out but creation finished),
+            # and a future 404 must mean external teardown, not retry.
+            recreate_pending.discard(node_id)
             state = node.get("state")
             if state in ("PREEMPTED", "TERMINATED"):
                 _recreate(node_id, state)

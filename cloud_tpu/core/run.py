@@ -235,11 +235,13 @@ def run(
         for d in temp_dirs:
             shutil.rmtree(d, ignore_errors=True)
 
-    if max_restarts > 0:
+    if max_restarts > 0 and not stream_logs:
         # After cleanup: supervision may run for the job's whole life and
         # needs none of the build artifacts.  Returns when the job's
         # nodes are torn down (delete_job/console) or raises when the
-        # restart budget is exhausted.
+        # restart budget is exhausted.  Not after stream_logs: the only
+        # way out of the log tail is Ctrl-C, and that interrupt means
+        # "stop run()", not "enter a second blocking loop".
         deploy.supervise_job(
             job_info, job_request, session=_session,
             max_restarts=max_restarts,

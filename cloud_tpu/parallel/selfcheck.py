@@ -23,6 +23,13 @@ init (default 60 s), and ``CLOUD_TPU_SELFCHECK_MODE`` picks the check:
   warns hangs (not errors) when mis-wired.
 - ``pp``: a pp x tp mesh whose pp axis spans processes, so the pipeline's
   ppermute shift register rides cross-process links.
+- ``tp``: an fsdp x tp mesh where the TP axis itself spans processes
+  (tp size > local device count; tp is the innermost canonical axis, so
+  a 4-wide tp over 2-device processes straddles the boundary) — the
+  activation all-reduces after every projection ride cross-process links.
+- ``sp``: an sp x tp mesh whose sp axis places NEIGHBORING ring ranks in
+  different processes, so ring attention's ppermute hops (fwd and bwd)
+  are real cross-process sends.
 - ``records``: every process streams its shard of a shared record dir
   (``CLOUD_TPU_SELFCHECK_RECORDS_DIR``) and reports the example ids it saw
   (the caller asserts the shards are disjoint and complete).
@@ -160,6 +167,22 @@ def run_selfcheck() -> dict:
         report["phase"] = "pp_step"
         _check_transformer(
             report, {"pp": jax.device_count() // 2, "tp": 2}, pipeline=True
+        )
+        report["phase"] = "done"
+        return report
+    if mode == "tp":
+        report["phase"] = "tp_step"
+        _check_transformer(
+            report, {"fsdp": jax.device_count() // 4, "tp": 4},
+            pipeline=False,
+        )
+        report["phase"] = "done"
+        return report
+    if mode == "sp":
+        report["phase"] = "sp_step"
+        _check_transformer(
+            report, {"sp": jax.device_count() // 2, "tp": 2},
+            pipeline=False,
         )
         report["phase"] = "done"
         return report

@@ -64,8 +64,9 @@ class CheckpointManager:
         self._manager.close()
 
 
-def resume_trainer_state(trainer, manager: CheckpointManager) -> bool:
-    """Restore the latest checkpoint into ``trainer.state`` if it is ahead.
+def resume_trainer_state(trainer, manager: CheckpointManager, *,
+                         only_if_ahead: bool = True) -> bool:
+    """Restore the latest checkpoint into ``trainer.state``.
 
     The ONE shared resume recipe (used by :class:`CheckpointCallback` and
     cloud_fit's server): restores WITHOUT the rng leaf — a checkpoint
@@ -76,11 +77,18 @@ def resume_trainer_state(trainer, manager: CheckpointManager) -> bool:
     state restores straight into its mesh layout.  Any restore failure
     logs and returns False (train from the fresh state) rather than
     killing the job at startup.
+
+    ``only_if_ahead`` (the preemption-recovery default) skips a
+    checkpoint not ahead of the current state.  cloud_fit passes False:
+    a user-uploaded state saved at step 0 (pretrained weights for a
+    fine-tune) must still replace the server's fresh init.
     """
     if trainer.state is None:
         return False
     latest = manager.latest_step()
-    if latest is None or latest <= int(trainer.state.step):
+    if latest is None:
+        return False
+    if only_if_ahead and latest <= int(trainer.state.step):
         return False
     current = trainer.state
     try:

@@ -34,6 +34,14 @@ def virtual_mesh_env(
     return env
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
 def launch_process_fleet(
     num_processes: int = 2,
     *,
@@ -54,11 +62,7 @@ def launch_process_fleet(
 
     Returns a list of ``subprocess.CompletedProcess`` in rank order.
     """
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
+    port = _free_port()
 
     procs = []
     for rank in range(num_processes):
@@ -81,10 +85,14 @@ def launch_process_fleet(
                 text=True,
             )
         )
-    # Drain every rank's pipes CONCURRENTLY: ranks run in lockstep through
-    # collectives, so a sequential drain would deadlock the moment any
-    # later rank fills its ~64KB pipe buffer while rank 0 is still being
-    # waited on.
+    return _drain_fleet(procs, timeout)
+
+
+def _drain_fleet(procs, timeout: int):
+    """Drain every rank's pipes CONCURRENTLY: ranks run in lockstep through
+    collectives, so a sequential drain would deadlock the moment any
+    later rank fills its ~64KB pipe buffer while rank 0 is still being
+    waited on."""
     from concurrent.futures import ThreadPoolExecutor
 
     def drain(proc):
@@ -99,13 +107,116 @@ def launch_process_fleet(
             return subprocess.CompletedProcess(proc.args, -9, out, err)
 
     try:
-        with ThreadPoolExecutor(max_workers=num_processes) as pool:
+        with ThreadPoolExecutor(max_workers=len(procs)) as pool:
             results = list(pool.map(drain, procs))
     finally:
         for proc in procs:
             if proc.poll() is None:
                 proc.kill()
     return results
+
+
+_CURL_SHIM = """#!/bin/bash
+# Fake TPU-VM metadata server: the startup script asks for
+# attributes/agent-worker-number; answer with this emulated host's index.
+echo -n "${AGENT_WORKER_NUMBER}"
+"""
+
+#: The launcher's OWN interpreter is substituted for __PYTHON__ — a PATH
+#: `python3` may be a different environment without jax installed.
+_DOCKER_SHIM = """#!/usr/bin/env python3
+\"\"\"Fake docker CLI for the emulated slice boot: `pull` is a no-op;
+`run` translates every `-e K=V` into the environment and execs the
+selfcheck module as "the container".\"\"\"
+import os, sys
+
+args = sys.argv[1:]
+if not args or args[0] == "pull":
+    sys.exit(0)
+env = dict(os.environ)
+rest = args[1:]
+while rest:
+    a = rest.pop(0)
+    if a == "-e":
+        k, _, v = rest.pop(0).partition("=")
+        env[k] = v
+python = __PYTHON__
+os.execvpe(python, [python, "-m", "cloud_tpu.parallel.selfcheck"], env)
+"""
+
+
+def launch_emulated_slice(
+    hosts_per_slice: int = 2,
+    *,
+    devices_per_process: int = 2,
+    extra_env: Optional[Dict[str, str]] = None,
+    timeout: int = 300,
+):
+    """Boot one multi-host slice by EXECUTING deploy's real startup script.
+
+    The hosts_per_slice>1 rank contract (``deploy.startup_script``: rank =
+    ``process_id_base`` + the ``agent-worker-number`` metadata attribute)
+    had only ever been golden-text-asserted; here it runs: the generated
+    bash script executes per emulated host with a shimmed ``curl`` (fake
+    metadata server answering the worker index from the environment) and
+    a shimmed ``docker`` (translates ``-e K=V`` into env and execs the
+    selfcheck module as the container).  The resulting processes form a
+    real ``jax.distributed`` job whose ranks came from the same
+    arithmetic a TPU VM would run at boot.
+
+    Returns CompletedProcess per host in worker-number order.
+    """
+    import stat
+    import tempfile
+
+    from cloud_tpu.core import deploy
+
+    port = _free_port()
+    script = deploy.startup_script(
+        "gcr.io/emulated/selfcheck:0",
+        coordinator_address=f"localhost:{port}",
+        num_processes=hosts_per_slice,
+        process_id_base=0,
+    )
+    tmp = tempfile.mkdtemp(prefix="cloud_tpu_slice_")
+    script_path = os.path.join(tmp, "startup-script.sh")
+    with open(script_path, "w") as f:
+        f.write(script)
+    bin_dir = os.path.join(tmp, "bin")
+    os.makedirs(bin_dir)
+    docker_shim = _DOCKER_SHIM.replace("__PYTHON__", repr(sys.executable))
+    for name, body in (("curl", _CURL_SHIM), ("docker", docker_shim)):
+        path = os.path.join(bin_dir, name)
+        with open(path, "w") as f:
+            f.write(body)
+        os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+    try:
+        procs = []
+        for worker in range(hosts_per_slice):
+            env = virtual_mesh_env(
+                devices_per_process,
+                {
+                    "AGENT_WORKER_NUMBER": str(worker),
+                    "PATH": bin_dir + os.pathsep + os.environ.get("PATH", ""),
+                    "CLOUD_TPU_SELFCHECK_FORCE_CPU": "1",
+                    **(extra_env or {}),
+                },
+            )
+            procs.append(
+                subprocess.Popen(
+                    ["bash", script_path],
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+            )
+        return _drain_fleet(procs, timeout)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def run_bootstrap(

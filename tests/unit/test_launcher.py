@@ -418,6 +418,38 @@ class TestDeploy:
         posts = [c for c in session.calls if c[0] == "POST"]
         assert len(posts) == 1  # budget 1: first recreate spent it
 
+    def test_supervise_pending_cleared_when_node_reappears(self):
+        """A recreate whose await failed leaves the node pending; if the
+        node then shows up healthy on its own, a LATER 404 must mean
+        external teardown (stop watching) — not resurrect the node the
+        user just deleted."""
+        plan = planner.plan_mesh(chief_config=TPU)
+        request = deploy.build_job_request("img", TPU, 0, plan, job_id="j")
+        job_info = {"job_id": "j", "nodes": list(request["nodes"]),
+                    "project": "p", "zone": "z"}
+
+        class Script(FakeSession):
+            def get(self, url, params=None):
+                if "/nodes/j-0" in url and not self.responses:
+                    self.calls.append(("GET", url, None, params))
+                    raise api_client.ApiError(404, "torn down")
+                return super().get(url, params=params)
+
+        session = Script(responses=[
+            {"state": "PREEMPTED"},              # round 1: preempted
+            {},                                  # DELETE
+            {"name": "ops/c", "done": True,
+             "error": {"code": 8}},              # recreate op fails -> pending
+            {"state": "READY"},                  # round 2: node appeared
+        ])                                       # round 3: 404 (teardown)
+        result = deploy.supervise_job(
+            job_info, request, session=session, max_restarts=5,
+            sleep=lambda _: None,
+        )
+        assert result["restarts"] == {"j-0": 1}
+        posts = [c for c in session.calls if c[0] == "POST"]
+        assert len(posts) == 1  # no resurrection after the teardown 404
+
     def test_run_wires_supervision(self, tmp_path, monkeypatch):
         """run(max_restarts=N) hands the submitted request to the
         supervisor so recreated nodes reuse the exact submitted bodies."""
