@@ -184,43 +184,22 @@ def _probe_main() -> int:
 # Measurement child: headline first, one salvageable JSON line per phase.
 
 
-def _measure_resnet_config(extras, prefix, config, *, image_hw, num_classes,
+def _measure_resnet_config(extras, prefix, *, imagenet_shape,
                            batch_size, warmup, iters):
     """One ResNet train-step measurement: build state, AOT-compile, time.
 
-    Returns steps/sec.  With mesh=None the step executes on ONE device
-    however many the endpoint exposes, so the measured rate already IS
-    per-chip — dividing by len(jax.devices()) would under-report N-fold.
+    Workload construction is shared with scripts/measure_baselines.py
+    (cloud_tpu/utils/benchmarking.resnet_train_setup) so both report the
+    same config.  Returns steps/sec.  With mesh=None the step executes on
+    ONE device however many the endpoint exposes, so the measured rate
+    already IS per-chip — dividing by len(jax.devices()) would
+    under-report N-fold.
     """
-    import functools
+    from cloud_tpu.utils.benchmarking import resnet_train_setup
 
-    import jax
-    import numpy as np
-    import optax
-
-    from cloud_tpu.models import resnet
-    from cloud_tpu.training import train as train_lib
-
-    state = train_lib.create_sharded_state(
-        jax.random.PRNGKey(0),
-        functools.partial(resnet.init, config=config),
-        optax.sgd(0.1, momentum=0.9),
-        mesh=None,
+    step, state, batch = resnet_train_setup(
+        imagenet_shape=imagenet_shape, batch_size=batch_size
     )
-    step = train_lib.make_train_step(
-        functools.partial(resnet.loss_fn, config=config),
-        optax.sgd(0.1, momentum=0.9),
-    )
-
-    rng = np.random.default_rng(0)
-    batch = {
-        "image": rng.normal(
-            size=(batch_size, image_hw, image_hw, 3)
-        ).astype(np.float32),
-        "label": rng.integers(0, num_classes, batch_size),
-    }
-    batch = jax.device_put(batch)
-
     compiled, flops = _compile_step(step, state, batch)
     steps_per_sec = _throughput(
         compiled, state, batch, warmup=warmup, iters=iters
@@ -233,15 +212,13 @@ def _measure_resnet(extras, *, corrected=False):
     """The headline: CIFAR-shape ResNet50 (the regression canary)."""
     import jax
 
-    from cloud_tpu.models import resnet
-
     extras["device_kind"] = getattr(jax.devices()[0], "device_kind", "?")
     extras["peak_bf16_tflops"] = _peak_bf16_tflops(jax.devices()[0])
     extras["group_norm_kernel_used"] = (
         os.environ.get("CLOUD_TPU_GN_KERNEL", "1") != "0"
     )
     steps_per_sec = _measure_resnet_config(
-        extras, "", resnet.RESNET50_CIFAR, image_hw=32, num_classes=10,
+        extras, "", imagenet_shape=False,
         batch_size=BATCH_SIZE, warmup=WARMUP_STEPS, iters=MEASURE_STEPS,
     )
     _emit_phase(
@@ -261,8 +238,6 @@ def _measure_resnet224(extras):
     convs, fully counted), so the MFU undercount is within ~1%.  CIFAR
     stays the headline/regression number; this is the utilization claim.
     """
-    from cloud_tpu.models import resnet
-
     # Record which GroupNorm path this phase actually ran: an earlier
     # in-child divergence (or a parent retry) flips the kill switch, and
     # the utilization claim must not be attributed to the kernel path
@@ -271,9 +246,8 @@ def _measure_resnet224(extras):
         os.environ.get("CLOUD_TPU_GN_KERNEL", "1") != "0"
     )
     steps_per_sec = _measure_resnet_config(
-        extras, "resnet224_", resnet.RESNET50, image_hw=224,
-        num_classes=1000, batch_size=R224_BATCH, warmup=R224_WARMUP,
-        iters=R224_MEASURE,
+        extras, "resnet224_", imagenet_shape=True,
+        batch_size=R224_BATCH, warmup=R224_WARMUP, iters=R224_MEASURE,
     )
     extras["resnet224_steps_per_sec"] = round(steps_per_sec, 3)
 

@@ -14,6 +14,7 @@ from cloud_tpu.training.train import (
     make_train_step,
     param_shardings,
 )
+from cloud_tpu.training import optimizers
 from cloud_tpu.training.trainer import (
     Callback,
     EarlyStopping,
@@ -26,6 +27,7 @@ from cloud_tpu.training.trainer import (
 
 __all__ = [
     "TrainState",
+    "optimizers",
     "Trainer",
     "Callback",
     "EarlyStopping",

@@ -31,3 +31,46 @@ def chain_then_read_throughput(step, state, batch, *, warmup=3, iters=20):
         state, metrics = step(state, batch)
     float(next(iter(metrics.values())))
     return iters / (time.perf_counter() - start)
+
+
+def resnet_train_setup(*, imagenet_shape: bool, batch_size: int):
+    """The ResNet benchmark workload, built ONCE for every measurer.
+
+    ``bench.py`` (the driver artifact) and ``scripts/measure_baselines.py``
+    must report the SAME workload when they both claim
+    resnet50-cifar/resnet50-224; constructing it here keeps the config,
+    optimizer, and synthetic batch in lockstep.  Returns
+    ``(step, state, batch)`` with the step un-compiled (bench.py AOT
+    lowers it for cost analysis; other callers may call it directly).
+    """
+    import functools
+
+    import jax
+    import numpy as np
+    import optax
+
+    from cloud_tpu.models import resnet
+    from cloud_tpu.training import train as train_lib
+
+    if imagenet_shape:
+        config, image_hw, num_classes = resnet.RESNET50, 224, 1000
+    else:
+        config, image_hw, num_classes = resnet.RESNET50_CIFAR, 32, 10
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = train_lib.create_sharded_state(
+        jax.random.PRNGKey(0),
+        functools.partial(resnet.init, config=config),
+        tx,
+        mesh=None,
+    )
+    step = train_lib.make_train_step(
+        functools.partial(resnet.loss_fn, config=config), tx
+    )
+    rng = np.random.default_rng(0)
+    batch = jax.device_put({
+        "image": rng.normal(
+            size=(batch_size, image_hw, image_hw, 3)
+        ).astype(np.float32),
+        "label": rng.integers(0, num_classes, batch_size),
+    })
+    return step, state, batch

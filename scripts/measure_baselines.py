@@ -64,9 +64,8 @@ def measure_mnist():
          "steps/sec")
 
 
-def measure_bert():
+def _bert_steps_per_sec(tx):
     import jax
-    import optax
 
     from cloud_tpu.models import bert
     from cloud_tpu.training import train as train_lib
@@ -74,17 +73,54 @@ def measure_bert():
     cfg = bert.BERT_BASE
     state = train_lib.create_sharded_state(
         jax.random.PRNGKey(0), functools.partial(bert.init, cfg=cfg),
-        optax.adamw(2e-5), mesh=None,
+        tx, mesh=None,
     )
     step = train_lib.make_train_step(
-        functools.partial(bert.loss_fn, cfg=cfg), optax.adamw(2e-5)
+        functools.partial(bert.loss_fn, cfg=cfg), tx
     )
     batch = jax.device_put({
         "tokens": np.ones((32, 128), np.int32),
         "label": np.zeros((32,), np.int64),
     })
+    return _throughput(step, state, batch, iters=20)
+
+
+def measure_bert():
+    import optax
+
     emit("bert_base_finetune_b32_s128_train_steps_per_sec",
-         _throughput(step, state, batch, iters=20), "steps/sec")
+         _bert_steps_per_sec(optax.adamw(2e-5)), "steps/sec")
+
+
+def measure_bert_optimizer_ab():
+    """The adamw HBM attack A/B (BASELINE.md "BERT MFU ceiling"): same
+    config with bf16-at-rest moments.  mu-only (the safe preset) and
+    both-moments (cast_state) variants; compare against measure_bert's
+    f32 number for the measured Delta the VERDICT asked for."""
+    import optax
+
+    from cloud_tpu.training import optimizers
+
+    emit("bert_b32_s128_mu_bf16_train_steps_per_sec",
+         _bert_steps_per_sec(optimizers.adamw(2e-5)), "steps/sec")
+    emit("bert_b32_s128_moments_bf16_train_steps_per_sec",
+         _bert_steps_per_sec(optimizers.cast_state(optax.adamw(2e-5))),
+         "steps/sec")
+
+
+def measure_resnet224():
+    """ImageNet-shape ResNet50 (224x224, b128): the MFU-honest vision
+    workload (VERDICT r3 #4) — CIFAR stays the regression canary; this
+    is the utilization claim.  The workload is built by the SAME helper
+    bench.py's resnet224 phase uses, so the two reports stay comparable
+    by construction."""
+    from cloud_tpu.utils.benchmarking import resnet_train_setup
+
+    step, state, batch = resnet_train_setup(
+        imagenet_shape=True, batch_size=128
+    )
+    emit("resnet50_imagenet224_b128_train_steps_per_sec",
+         _throughput(step, state, batch, iters=10), "steps/sec")
 
 
 def measure_tuner():
@@ -205,6 +241,8 @@ def measure_submit_latency():
 def main():
     measure_mnist()
     measure_bert()
+    measure_bert_optimizer_ab()
+    measure_resnet224()
     measure_data_pipeline()
     measure_tuner()
     measure_submit_latency()
