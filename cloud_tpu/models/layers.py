@@ -148,7 +148,7 @@ def causal_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
 def sharded_attention(q, k, v, *, causal: bool,
                       mask: Optional[jnp.ndarray] = None,
                       rules: ShardingRules = DEFAULT_RULES, mesh=None,
-                      zigzag: bool = False):
+                      zigzag: bool = False, ulysses: bool = False):
     """Mesh-aware attention dispatch over [B, T, H, D] tensors.
 
     The single routing point shared by CloudLM and BERT:
@@ -160,7 +160,13 @@ def sharded_attention(q, k, v, *, causal: bool,
       sdy level there — "manual axis after free axis" — and an unwrapped
       pallas_call would be fully replicated; custom_partitioning is the
       route that keeps pipelined attention O(T), VERDICT r2 weak #5.)
-    - ``sp`` > 1 and no mask: ring attention over the sequence axis
+    - ``sp`` > 1 and ``ulysses``: sequence<->head re-sharding all-to-all
+      (the DeepSpeed-Ulysses pattern) — each rank attends over the FULL
+      sequence for its head group, so there are no ring hops at all:
+      2 collectives in, 1 out, total comm O(1/sp) of the activations vs
+      the ring's O(sp) K/V hops.  Requires local heads (H / tp) to
+      divide by sp; indivisible head counts fall back to the ring.
+    - ``sp`` > 1 otherwise: ring attention over the sequence axis
     - mesh present: ``partitioned=True`` dispatch here too — measured
       ~11% faster than the former full-manual shard_map wrapper on a v5e
       chip (B2 T2048 H8 D64 value+grad) and one code path instead of two
@@ -169,7 +175,9 @@ def sharded_attention(q, k, v, *, causal: bool,
     ``mask`` is a [B, T_k] valid-token padding mask; the flash kernels
     apply it key-side (flash_attention docstring).  With ``sp`` > 1 the
     mask shards over the sequence axis and rides the ring with its K/V
-    block (zig-zag stays causal/unmasked — pretraining layout).
+    block (zig-zag stays causal/unmasked — pretraining layout); on the
+    Ulysses path every rank holds the full sequence, so the mask enters
+    replicated over sp instead.
     """
     from functools import partial
 
@@ -186,6 +194,53 @@ def sharded_attention(q, k, v, *, causal: bool,
     if sharding_lib.manual_context_mesh() is not None:
         return ops.flash_attention(q, k, v, causal=causal, mask=mask,
                                    partitioned=True)
+    if sp_size > 1 and ulysses:
+        from cloud_tpu.parallel import collectives
+
+        batch_axes = rules.assignment("batch")
+        heads_axes = rules.assignment("heads")
+        tp_shards = 1
+        for axis_name in (
+            heads_axes if isinstance(heads_axes, tuple) else (heads_axes,)
+        ):
+            if axis_name:
+                tp_shards *= dict(mesh.shape).get(axis_name, 1)
+        local_heads = q.shape[2] // max(tp_shards, 1)
+        if local_heads % sp_size == 0:
+            spec = PartitionSpec(
+                batch_axes, mesh_lib.AXIS_SP, heads_axes, None
+            )
+
+            def ulysses_fn(q_, k_, v_, m_=None):
+                to_heads = partial(
+                    collectives.all_to_all_seq_heads, axis=mesh_lib.AXIS_SP,
+                    to_heads=True,
+                )
+                out = ops.flash_attention(
+                    to_heads(q_), to_heads(k_), to_heads(v_),
+                    causal=causal, mask=m_,
+                )
+                return collectives.all_to_all_seq_heads(
+                    out, mesh_lib.AXIS_SP, to_heads=False
+                )
+
+            if mask is not None:
+                # Each rank attends over the FULL sequence: the [B, T]
+                # mask must arrive whole (replicated over sp).
+                args = (q, k, v, mask)
+                in_specs = (spec, spec, spec,
+                            PartitionSpec(batch_axes, None))
+            else:
+                args, in_specs = (q, k, v), (spec, spec, spec)
+            return jax.shard_map(
+                ulysses_fn,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=spec,
+                check_vma=False,
+            )(*args)
+        # Indivisible head group: fall through to the ring (which has no
+        # divisibility requirement on heads).
     if sp_size > 1:
         from cloud_tpu.parallel.ring_attention import ring_attention_balanced
 

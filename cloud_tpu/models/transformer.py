@@ -61,6 +61,13 @@ class TransformerConfig:
     #: targets through the permutation — callers keep feeding sequences in
     #: natural order.  Incompatible with pp (the pipeline path).
     zigzag_sp: bool = False
+    #: With sp > 1: run attention as sequence<->head all-to-alls instead
+    #: of ring hops (the DeepSpeed-Ulysses pattern; layers.sharded_attention
+    #: docstring).  Total comm is O(1/sp) of the activations vs the ring's
+    #: O(sp) K/V hops, but local heads (H / tp) must divide by sp —
+    #: indivisible configs silently use the ring.  Mutually exclusive
+    #: with zigzag_sp.
+    ulysses_sp: bool = False
 
     def scaled(self, **kw) -> "TransformerConfig":
         return dataclasses.replace(self, **kw)
@@ -185,7 +192,7 @@ def _attention(
 
     attended = layers.sharded_attention(
         q, k, v, causal=True, rules=rules, mesh=mesh,
-        zigzag=config.zigzag_sp,
+        zigzag=config.zigzag_sp, ulysses=config.ulysses_sp,
     )
 
     attended = attended.reshape(b, t, h * hd)
@@ -285,6 +292,10 @@ def apply(
     mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
     b, t = tokens.shape
     zigzag = _zigzag_active(config, mesh)
+    if config.zigzag_sp and config.ulysses_sp:
+        raise ValueError(
+            "zigzag_sp and ulysses_sp are mutually exclusive sp strategies"
+        )
     if zigzag:
         if _is_pipelined(config, rules, mesh):
             raise ValueError("zigzag_sp is incompatible with pp pipelining")

@@ -30,6 +30,9 @@ init (default 60 s), and ``CLOUD_TPU_SELFCHECK_MODE`` picks the check:
 - ``sp``: an sp x tp mesh whose sp axis places NEIGHBORING ring ranks in
   different processes, so ring attention's ppermute hops (fwd and bwd)
   are real cross-process sends.
+- ``ulysses``: the same sp x tp mesh with ``ulysses_sp`` — the
+  sequence<->head all-to-alls cross the process boundary instead of
+  ring hops.
 - ``records``: every process streams its shard of a shared record dir
   (``CLOUD_TPU_SELFCHECK_RECORDS_DIR``) and reports the example ids it saw
   (the caller asserts the shards are disjoint and complete).
@@ -42,7 +45,8 @@ import os
 import sys
 
 
-def _check_transformer(report, mesh_sizes, *, pipeline: bool) -> None:
+def _check_transformer(report, mesh_sizes, *, pipeline: bool,
+                       ulysses: bool = False) -> None:
     """One CloudLM train step on a model-parallel mesh; loss into report."""
     import functools
 
@@ -60,6 +64,8 @@ def _check_transformer(report, mesh_sizes, *, pipeline: bool) -> None:
         else parallel.DEFAULT_RULES
     )
     cfg = transformer.TINY
+    if ulysses:
+        cfg = cfg.scaled(ulysses_sp=True)
     mesh = parallel.MeshSpec(mesh_sizes).build()
     report["mesh"] = {k: v for k, v in mesh.shape.items() if v > 1}
     logical_axes = transformer.param_logical_axes(cfg)
@@ -183,6 +189,17 @@ def run_selfcheck() -> dict:
         _check_transformer(
             report, {"sp": jax.device_count() // 2, "tp": 2},
             pipeline=False,
+        )
+        report["phase"] = "done"
+        return report
+    if mode == "ulysses":
+        # sp=2 x tp=2 over 2-device processes: both all-to-alls (seq->
+        # heads and back) cross the process boundary.  TINY has 4 heads,
+        # tp=2 -> 2 local heads, divisible by sp=2.
+        report["phase"] = "ulysses_step"
+        _check_transformer(
+            report, {"sp": jax.device_count() // 2, "tp": 2},
+            pipeline=False, ulysses=True,
         )
         report["phase"] = "done"
         return report

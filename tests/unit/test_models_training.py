@@ -5,6 +5,7 @@ attention exactly, MoE/pp/ep configurations compile and run, Trainer
 callback protocol, checkpoint round-trip.
 """
 
+import contextlib
 import dataclasses
 import functools
 
@@ -1257,3 +1258,105 @@ class TestLowPrecisionOptimizerState:
         assert optimizers.optimizer_state_bytes(
             cast_none.init(params)
         ) == optimizers.optimizer_state_bytes(optax.adamw(1e-2).init(params))
+
+
+class TestUlyssesAttention:
+    """Ulysses sequence parallelism (sp via seq<->head all-to-all): exact
+    equivalence with the dense single-device forward, gradients included,
+    plus the padding-mask path and the indivisible-heads ring fallback."""
+
+    def _setup(self, sp=4, tp=1, ulysses=True):
+        cfg = transformer.TINY.scaled(
+            dtype=jnp.float32, num_layers=2, ulysses_sp=ulysses
+        )
+        sizes = {"sp": sp}
+        if tp > 1:
+            sizes["tp"] = tp
+        if sp * tp < 8:
+            sizes["dp"] = 8 // (sp * tp)  # the rig mesh must use all 8
+        mesh = parallel.MeshSpec(sizes).build()
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(1, 255, (2, 32)).astype(np.int32)
+        return cfg, mesh, params, jnp.asarray(tokens)
+
+    def test_matches_dense_forward_and_grad(self):
+        cfg, mesh, params, tokens = self._setup(sp=4, tp=2)
+
+        def loss(p, cfg_, mesh_):
+            logits, _ = transformer.apply(p, tokens, cfg_, mesh=mesh_)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        dense_cfg = cfg.scaled(ulysses_sp=False)
+        want, want_grads = jax.value_and_grad(
+            lambda p: loss(p, dense_cfg, None)
+        )(params)
+        with parallel.use_mesh(mesh):
+            got, got_grads = jax.jit(
+                jax.value_and_grad(lambda p: loss(p, cfg, mesh))
+            )(params)
+        np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+        for g, w in zip(
+            jax.tree_util.tree_leaves(got_grads),
+            jax.tree_util.tree_leaves(want_grads),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=5e-4, atol=5e-6
+            )
+
+    def test_mask_rides_replicated(self):
+        from cloud_tpu.models import layers as layers_lib
+
+        mesh = parallel.MeshSpec({"dp": 2, "sp": 4}).build()
+        rng = np.random.default_rng(1)
+        b, t, h, d = 2, 16, 4, 8
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+            for _ in range(3)
+        )
+        mask = jnp.asarray([[1] * 12 + [0] * 4, [1] * 16], jnp.int32)
+        want = layers_lib.sharded_attention(
+            q, k, v, causal=False, mask=mask, mesh=None
+        )
+        with parallel.use_mesh(mesh):
+            got = jax.jit(
+                lambda q_, k_, v_, m_: layers_lib.sharded_attention(
+                    q_, k_, v_, causal=False, mask=m_, mesh=mesh,
+                    ulysses=True,
+                )
+            )(q, k, v, mask)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+    def test_indivisible_heads_fall_back_to_ring(self):
+        # TINY has 4 heads; sp=8 > heads => Ulysses ineligible, ring runs
+        # (which handles any head count) — same numbers either way.
+        cfg, mesh, params, tokens = self._setup(sp=8, tp=1)
+        dense_cfg = cfg.scaled(ulysses_sp=False)
+
+        def logits_of(cfg_, mesh_):
+            with parallel.use_mesh(mesh) if mesh_ is not None else (
+                contextlib.nullcontext()
+            ):
+                out, _ = (
+                    jax.jit(
+                        lambda p: transformer.apply(
+                            p, tokens, cfg_, mesh=mesh_
+                        )
+                    )(params)
+                    if mesh_ is not None
+                    else transformer.apply(params, tokens, cfg_, mesh=None)
+                )
+            return np.asarray(out)
+
+        want = logits_of(dense_cfg, None)
+        got = logits_of(cfg, mesh)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_zigzag_and_ulysses_refused_together(self):
+        cfg, mesh, params, tokens = self._setup(sp=4)
+        bad = cfg.scaled(zigzag_sp=True)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            with parallel.use_mesh(mesh):
+                transformer.apply(params, tokens, bad, mesh=mesh)
