@@ -86,6 +86,25 @@ TOTAL_BUDGET_S = float(os.environ.get("CLOUD_TPU_BENCH_TOTAL_BUDGET", 1200))
 PROBE_BACKOFF_S = 20.0
 ATTEMPT_BACKOFF_S = 15.0
 
+#: Where the in-round bench daemon (scripts/bench_daemon.py) appends one
+#: timestamped JSON line per successful hardware measurement.  When the
+#: driver-run probes above all fail (tunnel down for the whole window, as
+#: in rounds 3-4), the parent falls back to the freshest daemon line so
+#: the round artifact records the best hardware number actually measured
+#: this round instead of 0.0.
+RUNS_PATH = os.environ.get(
+    "CLOUD_TPU_BENCH_RUNS_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BASELINE_runs.jsonl"),
+)
+#: Daemon lines older than this are stale — a different round's tunnel.
+#: Sized to one round's wall-clock (the daemon also rotates any pre-existing
+#: runs file aside at startup, which is the primary cross-round guard;
+#: this age filter is the backstop for a round whose daemon never started).
+DAEMON_MAX_AGE_S = float(
+    os.environ.get("CLOUD_TPU_BENCH_DAEMON_MAX_AGE", 12.5 * 3600)
+)
+
 
 def _peak_bf16_tflops(device) -> float:
     """Per-chip bf16 peak (dense) by device kind; 0.0 when unknown (CPU)."""
@@ -555,6 +574,66 @@ def _push_error(errors, message):
         errors.append("... further errors suppressed")
 
 
+def merge_attempt_lines(lines, merged, errors):
+    """Fold one measurement child's phase lines into ``merged``/``errors``.
+
+    Returns ``(headline, headline_used_kernel, gn_diverged)``.  Shared
+    with scripts/bench_daemon.py so the daemon's jsonl records and the
+    driver artifact are assembled by the same rules (LAST ok resnet line
+    wins — a corrected re-measure supersedes; a later None extra never
+    masks an earlier real result)."""
+    headline = None
+    headline_used_kernel = False
+    gn_diverged = False
+    for entry in lines:
+        if entry.get("phase") == "resnet" and entry.get("ok"):
+            headline = float(entry["value"])
+            extras = entry.get("extras") or {}
+            headline_used_kernel = bool(extras.get("group_norm_kernel_used"))
+        if entry.get("phase") == "group_norm" and not entry.get("ok"):
+            gn_diverged = True
+        for key, value in (entry.get("extras") or {}).items():
+            if value is None and merged.get(key) is not None:
+                continue
+            merged[key] = value
+        if not entry.get("ok") and entry.get("error"):
+            _push_error(errors, f"{entry['phase']}: {entry['error'][:300]}")
+    return headline, headline_used_kernel, gn_diverged
+
+
+def freshest_daemon_record(now=None):
+    """Newest in-round daemon line with a real headline, or None.
+
+    Reads RUNS_PATH (appended by scripts/bench_daemon.py), skipping
+    malformed lines, zero/absent headlines, and lines older than
+    DAEMON_MAX_AGE_S."""
+    try:
+        with open(RUNS_PATH, encoding="utf-8") as f:
+            raw = f.readlines()
+    except OSError:
+        return None
+    now = time.time() if now is None else now
+    best = None
+    for line in raw:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        value = rec.get("value")
+        ts = rec.get("ts")
+        if not isinstance(value, (int, float)) or not value:
+            continue
+        if not isinstance(ts, (int, float)):
+            continue
+        if now - ts > DAEMON_MAX_AGE_S:
+            continue
+        if best is None or ts > best["ts"]:
+            best = rec
+    return best
+
+
 def main() -> int:
     deadline = time.monotonic() + TOTAL_BUDGET_S
     errors = []
@@ -610,27 +689,9 @@ def main() -> int:
         lines, err = _run_child(
             "--child", min(ATTEMPT_TIMEOUT_S, remaining - 5), env=env
         )
-        headline = None
-        headline_used_kernel = False
-        gn_diverged = False
-        for entry in lines:
-            if entry.get("phase") == "resnet" and entry.get("ok"):
-                headline = float(entry["value"])
-                extras = entry.get("extras") or {}
-                headline_used_kernel = bool(
-                    extras.get("group_norm_kernel_used")
-                )
-            if entry.get("phase") == "group_norm" and not entry.get("ok"):
-                gn_diverged = True
-            for key, value in (entry.get("extras") or {}).items():
-                # A later None ("not exercised", e.g. the GN gate skipped
-                # on a kernel-off retry) must not mask an earlier real
-                # result (e.g. the divergence that caused that retry).
-                if value is None and merged.get(key) is not None:
-                    continue
-                merged[key] = value
-            if not entry.get("ok") and entry.get("error"):
-                _push_error(errors, f"{entry['phase']}: {entry['error'][:300]}")
+        headline, headline_used_kernel, gn_diverged = merge_attempt_lines(
+            lines, merged, errors
+        )
         if headline is not None and gn_diverged and headline_used_kernel:
             # The gate proved the kernel wrong and no corrected line
             # superseded the kernel-path number (a corrected line carries
@@ -661,6 +722,29 @@ def main() -> int:
     if headline is not None:
         _emit(headline, extras=merged,
               error="; ".join(errors) if errors else "")
+        return 0
+
+    # Every driver-run probe/attempt failed (tunnel down for the whole
+    # window — the rounds 3-4 failure mode).  Fall back to the freshest
+    # measurement the in-round daemon captured while the tunnel WAS up,
+    # clearly marked as daemon-sourced with its timestamp and age.
+    daemon = freshest_daemon_record()
+    if daemon is not None:
+        extras = dict(daemon.get("extras") or {})
+        extras.update(
+            source="in_round_daemon",
+            daemon_ts=daemon["ts"],
+            daemon_iso=daemon.get("iso"),
+            daemon_age_seconds=round(time.time() - daemon["ts"], 1),
+        )
+        for key, value in merged.items():
+            extras.setdefault(key, value)
+        note = (
+            "driver-run probes all failed; value is the freshest "
+            "in-round daemon measurement (scripts/bench_daemon.py)"
+        )
+        _emit(float(daemon["value"]), extras=extras,
+              error="; ".join([note] + errors))
         return 0
     _emit(0.0, extras=merged, error="; ".join(errors) or "no attempts ran")
     return 1

@@ -1,0 +1,278 @@
+"""Continuous in-round hardware bench daemon.
+
+Why (VERDICT r4, "Next round #1"): the TPU endpoint is reached through a
+tunnel that flaps for hours.  Rounds 3 and 4 both recorded 0.0 in the
+driver artifact because the end-of-round bench window happened to land on
+a dead tunnel, leaving every perf claim of two rounds uncorroborated.
+This daemon makes hardware measurement OPPORTUNISTIC and CONTINUOUS:
+started at round begin and left running, it loops
+
+    cheap probe -> (tunnel up?) -> full bench phases -> append one
+    timestamped JSON line to BASELINE_runs.jsonl
+
+so the round captures a verified number during ANY window the tunnel is
+alive.  ``bench.py`` (the driver entry) falls back to the freshest line
+here when its own probes fail, marked ``"source": "in_round_daemon"``.
+
+The measurement children are ``bench.py --probe`` / ``bench.py --child``
+(identical workloads and chain-then-read timing contract as the driver
+artifact), plus this file's own ``--ab`` child: the BERT optimizer-state
+A/B (f32 adamw vs bf16-mu vs bf16-both-moments) that BASELINE.md's "BERT
+MFU ceiling" section needs hardware numbers for.
+
+Run:  nohup python scripts/bench_daemon.py >> bench_daemon.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import datetime
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Total daemon lifetime; default sized to a full round's wall-clock.
+BUDGET_S = float(os.environ.get("CLOUD_TPU_BENCH_DAEMON_BUDGET", 11.5 * 3600))
+#: Sleep between probes while the tunnel is down (each failed probe also
+#: burns its own ~75 s timeout, so the effective down-poll period is ~3 min).
+IDLE_SLEEP_S = float(os.environ.get("CLOUD_TPU_BENCH_DAEMON_IDLE", 100))
+#: Sleep after a successful measurement cycle: repeated points confirm
+#: stability without hammering the shared endpoint.
+SUCCESS_SLEEP_S = float(os.environ.get("CLOUD_TPU_BENCH_DAEMON_SUCCESS", 900))
+AB_TIMEOUT_S = float(os.environ.get("CLOUD_TPU_BENCH_DAEMON_AB_TIMEOUT", 540))
+
+AB_WARMUP = 3
+AB_ITERS = 15
+AB_BATCH = 32
+AB_SEQ = 128
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _log(message: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+    print(f"[{stamp}] {message}", flush=True)
+
+
+def _rotate_stale_runs(bench) -> None:
+    """Archive a pre-existing runs file at startup.
+
+    The daemon starts at round begin, so anything already in RUNS_PATH is
+    a previous round's tunnel — the driver's fallback must never see it
+    (bench.DAEMON_MAX_AGE_S is only the backstop for rounds whose daemon
+    never started)."""
+    if os.path.exists(bench.RUNS_PATH):
+        archive = bench.RUNS_PATH + ".prev"
+        os.replace(bench.RUNS_PATH, archive)
+        _log(f"rotated stale runs file to {archive}")
+
+
+def _last_ab_line(stdout):
+    """Last bert_opt_ab JSON line in a child's stdout (one is printed per
+    completed variant, so the last is the most complete), or None."""
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode("utf-8", "replace")
+    ab_line = None
+    for line in (stdout or "").splitlines():
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(cand, dict) and cand.get("phase") == "bert_opt_ab":
+            ab_line = cand
+    return ab_line
+
+
+def _append_record(bench, record: dict) -> None:
+    record = dict(record)
+    record["ts"] = time.time()
+    record["iso"] = datetime.datetime.now(
+        datetime.timezone.utc
+    ).isoformat(timespec="seconds")
+    with open(bench.RUNS_PATH, "a", encoding="utf-8") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+# --------------------------------------------------------------------------
+# --ab child: BERT optimizer-state A/B on the device.
+
+
+def _ab_main() -> int:
+    """Measure BERT b32xs128 steps/sec under three optimizer-state widths.
+
+    f32 (optax.adamw, the r2/r3 baseline config), bf16 mu
+    (cloud_tpu.training.optimizers.adamw — the shipped default claim), and
+    bf16 both moments (cast_state; nu narrowing is the risky one, measured
+    for the traffic datapoint only).  Prints ONE JSON line.
+    """
+    import functools
+
+    import jax
+    import numpy as np
+    import optax
+
+    sys.path.insert(0, REPO)
+    from cloud_tpu.models import bert
+    from cloud_tpu.training import optimizers as opt_lib
+    from cloud_tpu.training import train as train_lib
+    from cloud_tpu.utils.benchmarking import chain_then_read_throughput
+
+    bench = _load_bench()
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"phase": "bert_opt_ab", "ok": False,
+                          "error": "backend is not tpu"}), flush=True)
+        return 1
+
+    cfg = bert.BERT_BASE
+    flops = bench._bert_analytic_flops(cfg, AB_BATCH, AB_SEQ)
+    peak = bench._peak_bf16_tflops(jax.devices()[0])
+    rng = np.random.default_rng(0)
+    batch = jax.device_put({
+        "tokens": rng.integers(
+            0, cfg.vocab_size, (AB_BATCH, AB_SEQ)
+        ).astype(np.int32),
+        "label": rng.integers(0, 2, AB_BATCH).astype(np.int64),
+    })
+
+    variants = {
+        "f32": optax.adamw(2e-5),
+        "bf16_mu": opt_lib.adamw(2e-5),
+        "bf16_both": opt_lib.cast_state(optax.adamw(2e-5)),
+    }
+    out = {"phase": "bert_opt_ab", "ok": True, "ab": {},
+           "batch": AB_BATCH, "seq": AB_SEQ}
+    for name, tx in variants.items():
+        state = train_lib.create_sharded_state(
+            jax.random.PRNGKey(0), functools.partial(bert.init, cfg=cfg),
+            tx, mesh=None,
+        )
+        step = train_lib.make_train_step(
+            functools.partial(bert.loss_fn, cfg=cfg), tx
+        )
+        compiled = step.lower(state, batch).compile()
+        steps_per_sec = chain_then_read_throughput(
+            compiled, state, batch, warmup=AB_WARMUP, iters=AB_ITERS
+        )
+        entry = {"steps_per_sec": round(steps_per_sec, 3),
+                 "ms_per_step": round(1000.0 / steps_per_sec, 3)}
+        if peak:
+            entry["mfu"] = round(flops * steps_per_sec / 1e12 / peak, 4)
+        out["ab"][name] = entry
+        # Partial results survive a mid-child hang: one line per variant,
+        # the parent keeps only the last (most complete) ab line.
+        print(json.dumps(out), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Daemon loop.
+
+
+def _cycle(bench) -> bool:
+    """One probe->measure cycle.  Returns True if a record was captured."""
+    probe_lines, probe_err = bench._run_child("--probe", bench.PROBE_TIMEOUT_S)
+    probe = next((p for p in probe_lines if p.get("ok")), None)
+    if probe is not None and probe.get("backend") != "tpu":
+        probe_err = f"backend {probe.get('backend')!r} (CPU fallback)"
+        probe = None
+    if probe is None:
+        _log(f"probe down: {probe_err or 'no output'}")
+        return False
+    _log(f"tunnel UP: {probe.get('n_devices')}x {probe.get('device_kind')}")
+
+    merged = {"device_kind": probe.get("device_kind"),
+              "n_devices": probe.get("n_devices")}
+    errors: list = []
+    lines, err = bench._run_child("--child", bench.ATTEMPT_TIMEOUT_S)
+    headline, headline_used_kernel, gn_diverged = bench.merge_attempt_lines(
+        lines, merged, errors
+    )
+    captured = False
+    if headline is not None and gn_diverged and headline_used_kernel:
+        # Same trust rule as the driver parent: a kernel-path headline
+        # contradicted by the GN gate is not a number of record.
+        _log("headline used divergent GN kernel; discarding this cycle")
+    elif headline is not None:
+        _append_record(bench, {
+            "source": "in_round_daemon",
+            "metric": bench.METRIC,
+            "value": round(headline, 3),
+            "unit": "steps/sec/chip",
+            "vs_baseline": round(
+                headline / bench.RECORDED_BASELINE_STEPS_PER_SEC, 3
+            ),
+            "extras": merged,
+            "errors": "; ".join(errors),
+        })
+        _log(f"captured headline {headline:.2f} steps/s "
+             f"(errors: {len(errors)})")
+        captured = True
+    else:
+        _log(f"no headline this cycle ({err or 'child died'}); "
+             f"errors: {'; '.join(errors)[:300]}")
+
+    # Optimizer-state A/B — independent child so its hang can't sink the
+    # headline above (already written).
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--ab"],
+            capture_output=True, text=True, timeout=AB_TIMEOUT_S, cwd=REPO,
+        )
+        ab_line = _last_ab_line(proc.stdout)
+        if ab_line and ab_line.get("ok"):
+            _append_record(bench, {"source": "in_round_daemon_ab",
+                                   "kind": "bert_opt_ab", **ab_line})
+            _log(f"captured bert_opt_ab: {json.dumps(ab_line.get('ab'))}")
+            captured = True
+        else:
+            tail = (proc.stderr or proc.stdout or "").strip()[-200:]
+            _log(f"ab child no result (rc={proc.returncode}, tail={tail!r})")
+    except subprocess.TimeoutExpired as exc:
+        ab_line = _last_ab_line(exc.stdout)
+        if ab_line:
+            _append_record(bench, {"source": "in_round_daemon_ab",
+                                   "kind": "bert_opt_ab", "partial": True,
+                                   **ab_line})
+            _log("ab child timed out; partial variants salvaged")
+        else:
+            _log("ab child timed out with no salvageable line")
+    return captured
+
+
+def main() -> int:
+    bench = _load_bench()
+    _rotate_stale_runs(bench)
+    deadline = time.monotonic() + BUDGET_S
+    _log(f"bench daemon up (budget {BUDGET_S:.0f}s, "
+         f"runs -> {bench.RUNS_PATH})")
+    while time.monotonic() < deadline:
+        try:
+            captured = _cycle(bench)
+        except Exception as exc:  # noqa: BLE001 — the daemon must outlive bugs
+            _log(f"cycle error: {type(exc).__name__}: {exc}")
+            captured = False
+        sleep_s = SUCCESS_SLEEP_S if captured else IDLE_SLEEP_S
+        sleep_s = min(sleep_s, max(0.0, deadline - time.monotonic()))
+        if sleep_s > 0:
+            time.sleep(sleep_s)
+    _log("budget exhausted; daemon exiting")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--ab" in sys.argv:
+        sys.exit(_ab_main())
+    sys.exit(main())
