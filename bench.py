@@ -635,6 +635,26 @@ def freshest_daemon_record(now=None):
 
 
 def main() -> int:
+    # Tell the in-round daemon a driver measurement is active: both grab
+    # the same single-chip endpoint, and a daemon cycle mid-flight could
+    # otherwise make every driver probe fail while the tunnel is up.
+    lock_path = RUNS_PATH + ".driver_lock"
+    try:
+        with open(lock_path, "w", encoding="utf-8") as f:
+            f.write(str(time.time()))
+    except OSError:
+        lock_path = None
+    try:
+        return _main_locked()
+    finally:
+        if lock_path:
+            try:
+                os.remove(lock_path)
+            except OSError:
+                pass
+
+
+def _main_locked() -> int:
     deadline = time.monotonic() + TOTAL_BUDGET_S
     errors = []
     merged = {}

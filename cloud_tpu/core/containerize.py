@@ -37,8 +37,49 @@ from cloud_tpu.utils import api_client
 
 logger = logging.getLogger(__name__)
 
-DEFAULT_BASE_IMAGE = "python:3.11-slim"
 LIBTPU_INDEX = "https://storage.googleapis.com/jax-releases/libtpu_releases.html"
+
+
+def default_base_image() -> str:
+    """``python:<local major.minor>-slim``.
+
+    Derived from the SUBMITTING interpreter the way the reference derived
+    its base image from the local TF version (containerize.py:134-158) —
+    cloud_fit ships cloudpickled closures whose bytecode only loads on the
+    same Python minor, so client and container must match by construction.
+    """
+    import sys
+
+    return f"python:{sys.version_info.major}.{sys.version_info.minor}-slim"
+
+
+def default_jax_pin() -> Optional[str]:
+    """``jax==<local jax.__version__>`` — client/container version lock.
+
+    The reference pinned the container's TF to the local TF (its whole
+    base-image selection, :134-158, existed for this); SURVEY §7 step 4
+    says "pin libtpu/JAX versions".  An unpinned ``jax[tpu]`` would make
+    the pod run whatever shipped that day, and serialized artifacts
+    (cloud_fit closures, mesh-plan JSON, checkpoints) are exactly what
+    breaks under skew.  jax's libtpu requirement is itself pinned by the
+    jax wheel, so pinning jax pins libtpu transitively.
+
+    Returns None (=> install unpinned, with a warning) when the local jax
+    is a dev/source build whose version has no PyPI release to pin to —
+    the reference's nightly fallback (:160-185) for the same situation.
+    """
+    import jax
+
+    version = jax.__version__
+    if "dev" in version or "+" in version:
+        logger.warning(
+            "local jax %s is a dev/source build with no released wheel; "
+            "container installs UNPINNED jax — set "
+            "DockerConfig(jax_version=...) to pin explicitly",
+            version,
+        )
+        return None
+    return f"jax=={version}"
 _CLOUD_BUILD_POLL_INTERVAL_SECONDS = 30
 _CLOUD_BUILD_POLL_ATTEMPTS = 20  # reference budget: 20 x 30s (:390,432-453)
 
@@ -48,9 +89,10 @@ class DockerConfig:
     """User knobs for image naming and building (reference run.py docker_config)."""
 
     image: Optional[str] = None  # full target URI; default gcr.io/<proj>/...
-    parent_image: Optional[str] = None  # overrides DEFAULT_BASE_IMAGE
+    parent_image: Optional[str] = None  # overrides default_base_image()
     cache_from: Optional[str] = None  # warm-layer source image
     image_build_bucket: Optional[str] = None  # GCS bucket => Cloud Build
+    jax_version: Optional[str] = None  # e.g. "0.9.1"; default = local jax
 
 
 def make_dockerfile(
@@ -62,13 +104,23 @@ def make_dockerfile(
     mesh_plan_json: Optional[str] = None,
     distribution_strategy: str = "auto",
     entry_point_args: Optional[List[str]] = None,
+    jax_version: Optional[str] = None,
 ) -> str:
-    """Render the Dockerfile text (golden-tested, like reference :134-228)."""
-    lines = [f"FROM {parent_image or DEFAULT_BASE_IMAGE}", "WORKDIR /app"]
+    """Render the Dockerfile text (golden-tested, like reference :134-228).
+
+    ``jax_version`` overrides the container's jax pin (a bare version
+    string like "0.9.1"); default pins to the submitting client's local
+    jax so local and remote provably match (see :func:`default_jax_pin`).
+    """
+    pin = f"jax=={jax_version}" if jax_version else default_jax_pin()
+    lines = [f"FROM {parent_image or default_base_image()}", "WORKDIR /app"]
     if machine_config.is_tpu_config(chief_config):
-        lines.append(f"RUN pip install --no-cache-dir 'jax[tpu]' -f {LIBTPU_INDEX}")
+        spec = (
+            pin.replace("jax==", "jax[tpu]==", 1) if pin else "jax[tpu]"
+        )
+        lines.append(f"RUN pip install --no-cache-dir '{spec}' -f {LIBTPU_INDEX}")
     else:
-        lines.append("RUN pip install --no-cache-dir jax")
+        lines.append(f"RUN pip install --no-cache-dir '{pin or 'jax'}'")
     if requirements_name:
         lines.append(f"COPY {requirements_name} /app/{requirements_name}")
         lines.append(

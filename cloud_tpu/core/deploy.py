@@ -48,6 +48,8 @@ def startup_script(
     coordinator_address: str,
     num_processes: int,
     process_id_base: int,
+    monitoring: bool = True,
+    profiler_port: Optional[int] = None,
 ) -> str:
     """TPU-VM startup script: pull + run the training container on each host.
 
@@ -56,22 +58,46 @@ def startup_script(
     global rank is base + local index.  This replaces the reference's
     resolver-wait prologue (preprocess.py:215-262) — topology is fully
     determined before boot.
+
+    ``monitoring=True`` (default) passes the exporter's enabling env pair
+    into the container so every deployed job exports runtime metrics with
+    zero user code — the reference registered its exporter into the
+    runtime and had the job spec set the env gate
+    (stackdriver_exporter.cc:31-36,128).  The project id is read from the
+    VM metadata server at boot (the node's own project is where its time
+    series belong), so building this script needs no ADC locally.
+    ``profiler_port`` additionally gates the on-demand profiler server
+    (bootstrap reads CLOUD_TPU_PROFILER_PORT; --net=host exposes it).
     """
-    return "\n".join(
-        [
-            "#! /bin/bash",
-            "set -ex",
-            'LOCAL_ID=$(curl -sf -H "Metadata-Flavor: Google" '
-            '"http://metadata.google.internal/computeMetadata/v1/instance/'
-            'attributes/agent-worker-number" || echo 0)',
-            f"docker pull {image_uri}",
-            "docker run --privileged --net=host \\",
-            f"  -e CLOUD_TPU_COORDINATOR={coordinator_address} \\",
-            f"  -e CLOUD_TPU_NUM_PROCESSES={num_processes} \\",
-            f"  -e CLOUD_TPU_PROCESS_ID=$(({process_id_base} + LOCAL_ID)) \\",
-            f"  {image_uri}",
+    lines = [
+        "#! /bin/bash",
+        "set -ex",
+        'LOCAL_ID=$(curl -sf -H "Metadata-Flavor: Google" '
+        '"http://metadata.google.internal/computeMetadata/v1/instance/'
+        'attributes/agent-worker-number" || echo 0)',
+    ]
+    if monitoring:
+        lines.append(
+            'PROJECT_ID=$(curl -sf -H "Metadata-Flavor: Google" '
+            '"http://metadata.google.internal/computeMetadata/v1/project/'
+            'project-id" || echo "")'
+        )
+    lines += [
+        f"docker pull {image_uri}",
+        "docker run --privileged --net=host \\",
+        f"  -e CLOUD_TPU_COORDINATOR={coordinator_address} \\",
+        f"  -e CLOUD_TPU_NUM_PROCESSES={num_processes} \\",
+        f"  -e CLOUD_TPU_PROCESS_ID=$(({process_id_base} + LOCAL_ID)) \\",
+    ]
+    if monitoring:
+        lines += [
+            "  -e CLOUD_TPU_MONITORING_ENABLED=1 \\",
+            "  -e CLOUD_TPU_MONITORING_PROJECT_ID=$PROJECT_ID \\",
         ]
-    )
+    if profiler_port:
+        lines.append(f"  -e CLOUD_TPU_PROFILER_PORT={int(profiler_port)} \\")
+    lines.append(f"  {image_uri}")
+    return "\n".join(lines)
 
 
 def build_node_request(
@@ -83,6 +109,8 @@ def build_node_request(
     process_id_base: int,
     job_labels: Optional[Dict[str, str]] = None,
     service_account: Optional[str] = None,
+    monitoring: bool = True,
+    profiler_port: Optional[int] = None,
 ) -> dict:
     """The TPU v2 API Node body for one slice (golden-tested)."""
     topo = config.tpu_topology()
@@ -95,6 +123,8 @@ def build_node_request(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id_base=process_id_base,
+                monitoring=monitoring,
+                profiler_port=profiler_port,
             )
         },
         "labels": dict(job_labels or {}),
@@ -113,6 +143,8 @@ def build_job_request(
     job_id: Optional[str] = None,
     job_labels: Optional[Dict[str, str]] = None,
     service_account: Optional[str] = None,
+    monitoring: bool = True,
+    profiler_port: Optional[int] = None,
 ) -> dict:
     """All node bodies for a (multi-)slice job, keyed by node id.
 
@@ -134,6 +166,8 @@ def build_job_request(
             process_id_base=i * hosts_per_slice,
             job_labels={**(job_labels or {}), "cloud_tpu_job": job_id},
             service_account=service_account,
+            monitoring=monitoring,
+            profiler_port=profiler_port,
         )
     return {"job_id": job_id, "nodes": nodes}
 
@@ -148,6 +182,8 @@ def deploy_job(
     zone: Optional[str] = None,
     job_labels: Optional[Dict[str, str]] = None,
     service_account: Optional[str] = None,
+    monitoring: bool = True,
+    profiler_port: Optional[int] = None,
     session: Optional[api_client.GcpApiSession] = None,
     stream_logs: bool = False,
     request: Optional[dict] = None,
@@ -185,6 +221,7 @@ def deploy_job(
         request = build_job_request(
             image_uri, chief_config, worker_count, plan,
             job_labels=job_labels, service_account=service_account,
+            monitoring=monitoring, profiler_port=profiler_port,
         )
     parent = f"projects/{project}/locations/{zone}"
     created: List[str] = []

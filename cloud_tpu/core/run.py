@@ -64,6 +64,8 @@ def run(
     parallelism_hints: Optional[planner.ParallelismHints] = None,
     dry_run: bool = False,
     max_restarts: int = 0,
+    monitoring: bool = True,
+    profiler_port: Optional[int] = None,
     _session=None,
     _builder=None,
     **kwargs,
@@ -78,8 +80,12 @@ def run(
     up to this many times and training resumes from the latest
     checkpoint; the reference delegated this to CAIP job restarts.
     Blocking, like ``stream_logs``; if both are set, log streaming wins
-    and supervision never starts).  ``_session``/``_builder`` are test
-    seams.
+    and supervision never starts).  ``monitoring`` (default True) makes
+    every deployed host export runtime metrics to Cloud Monitoring with
+    zero user code — the job spec carries the exporter's env gate, the
+    reference's stackdriver_exporter.cc:31-36 contract;
+    ``profiler_port`` additionally starts the on-demand profiler server
+    on each host.  ``_session``/``_builder`` are test seams.
 
     Returns a RunReport.  In script mode (entry_point=None, run() called
     from the training script itself) the local process exits after
@@ -166,6 +172,7 @@ def run(
             os.path.basename(requirements_txt) if requirements_txt else None
         ),
         parent_image=docker_config.parent_image,
+        jax_version=docker_config.jax_version,
         mesh_plan_json=plan.to_json() if plan else None,
         distribution_strategy="auto" if distribution_strategy == "auto" else "none",
         entry_point_args=entry_point_args,
@@ -178,6 +185,7 @@ def run(
     job_request = deploy.build_job_request(
         image_uri, chief_config, worker_count, deploy_plan,
         job_labels=job_labels, service_account=service_account,
+        monitoring=monitoring, profiler_port=profiler_port,
     )
     report = RunReport(
         image_uri=image_uri, mesh_plan=plan, dockerfile=dockerfile,
@@ -213,6 +221,7 @@ def run(
                 report.image_uri, chief_config, worker_count, deploy_plan,
                 job_id=job_request["job_id"],
                 job_labels=job_labels, service_account=service_account,
+                monitoring=monitoring, profiler_port=profiler_port,
             )
             report.node_requests = job_request["nodes"]
 

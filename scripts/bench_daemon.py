@@ -73,11 +73,36 @@ def _rotate_stale_runs(bench) -> None:
     The daemon starts at round begin, so anything already in RUNS_PATH is
     a previous round's tunnel — the driver's fallback must never see it
     (bench.DAEMON_MAX_AGE_S is only the backstop for rounds whose daemon
-    never started)."""
+    never started).  For a MID-round restart set
+    CLOUD_TPU_BENCH_DAEMON_KEEP_RUNS=1 so this round's captures survive.
+    The archive APPENDS (a second restart must not clobber the first
+    archive's lines)."""
+    if os.environ.get("CLOUD_TPU_BENCH_DAEMON_KEEP_RUNS") == "1":
+        return
     if os.path.exists(bench.RUNS_PATH):
         archive = bench.RUNS_PATH + ".prev"
-        os.replace(bench.RUNS_PATH, archive)
-        _log(f"rotated stale runs file to {archive}")
+        with open(bench.RUNS_PATH, encoding="utf-8") as src, open(
+            archive, "a", encoding="utf-8"
+        ) as dst:
+            dst.write(src.read())
+        os.remove(bench.RUNS_PATH)
+        _log(f"rotated stale runs file into {archive}")
+
+
+def _driver_active(bench) -> bool:
+    """True while bench.py (the driver artifact run) holds its lock.
+
+    The daemon yields the endpoint: a daemon child mid-measurement would
+    make the driver's own probes fail and force it onto the stale-er
+    fallback.  A lock older than the driver's largest possible budget is
+    a crashed driver — ignore it."""
+    lock_path = bench.RUNS_PATH + ".driver_lock"
+    try:
+        with open(lock_path, encoding="utf-8") as f:
+            started = float(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return False
+    return (time.time() - started) < max(2 * bench.TOTAL_BUDGET_S, 3600)
 
 
 def _last_ab_line(stdout):
@@ -181,8 +206,14 @@ def _ab_main() -> int:
 # Daemon loop.
 
 
-def _cycle(bench) -> bool:
-    """One probe->measure cycle.  Returns True if a record was captured."""
+def _cycle(bench, state) -> bool:
+    """One probe->measure cycle.  Returns True if a HEADLINE was captured
+    (the sleep decision: an AB-only capture must not slow headline
+    retries on a flapping tunnel).  ``state['force_gn_off']`` persists
+    the driver's kernel-distrust rule across cycles."""
+    if _driver_active(bench):
+        _log("driver run active; yielding the endpoint this cycle")
+        return False
     probe_lines, probe_err = bench._run_child("--probe", bench.PROBE_TIMEOUT_S)
     probe = next((p for p in probe_lines if p.get("ok")), None)
     if probe is not None and probe.get("backend") != "tpu":
@@ -196,15 +227,22 @@ def _cycle(bench) -> bool:
     merged = {"device_kind": probe.get("device_kind"),
               "n_devices": probe.get("n_devices")}
     errors: list = []
-    lines, err = bench._run_child("--child", bench.ATTEMPT_TIMEOUT_S)
+    env = (
+        dict(os.environ, CLOUD_TPU_GN_KERNEL="0")
+        if state.get("force_gn_off") else None
+    )
+    lines, err = bench._run_child("--child", bench.ATTEMPT_TIMEOUT_S, env=env)
     headline, headline_used_kernel, gn_diverged = bench.merge_attempt_lines(
         lines, merged, errors
     )
     captured = False
     if headline is not None and gn_diverged and headline_used_kernel:
         # Same trust rule as the driver parent: a kernel-path headline
-        # contradicted by the GN gate is not a number of record.
-        _log("headline used divergent GN kernel; discarding this cycle")
+        # contradicted by the GN gate is not a number of record.  Next
+        # cycle runs with the kernel disabled (driver's force_gn_off).
+        state["force_gn_off"] = True
+        _log("headline used divergent GN kernel; discarding this cycle "
+             "and disabling the kernel for subsequent cycles")
     elif headline is not None:
         _append_record(bench, {
             "source": "in_round_daemon",
@@ -236,7 +274,6 @@ def _cycle(bench) -> bool:
             _append_record(bench, {"source": "in_round_daemon_ab",
                                    "kind": "bert_opt_ab", **ab_line})
             _log(f"captured bert_opt_ab: {json.dumps(ab_line.get('ab'))}")
-            captured = True
         else:
             tail = (proc.stderr or proc.stdout or "").strip()[-200:]
             _log(f"ab child no result (rc={proc.returncode}, tail={tail!r})")
@@ -258,9 +295,10 @@ def main() -> int:
     deadline = time.monotonic() + BUDGET_S
     _log(f"bench daemon up (budget {BUDGET_S:.0f}s, "
          f"runs -> {bench.RUNS_PATH})")
+    state: dict = {}
     while time.monotonic() < deadline:
         try:
-            captured = _cycle(bench)
+            captured = _cycle(bench, state)
         except Exception as exc:  # noqa: BLE001 — the daemon must outlive bugs
             _log(f"cycle error: {type(exc).__name__}: {exc}")
             captured = False
