@@ -145,6 +145,37 @@ def causal_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     return _reference(q, k, v, causal=causal, mask=mask)
 
 
+def ulysses_eligible(num_heads: int, mesh,
+                     rules: ShardingRules = DEFAULT_RULES) -> bool:
+    """True when the Ulysses seq<->head all-to-all layout exists here.
+
+    The all-to-all re-shards [B, T/sp, H_local, D] into [B, T, H_local/sp,
+    D], so the LOCAL head group (num_heads / tp shards over the 'heads'
+    axes) must divide by the sp axis size.  Factored out of
+    :func:`sharded_attention` so tests can assert which path a config
+    actually takes (an ineligible config silently falls back to ring
+    attention — ADVICE r4: the only grad-checking Ulysses test was
+    accidentally asserting the fallback).
+    """
+    from cloud_tpu.parallel import mesh as mesh_lib
+
+    if mesh is None:
+        return False
+    shape = dict(mesh.shape)
+    sp_size = shape.get(mesh_lib.AXIS_SP, 1)
+    if sp_size <= 1:
+        return False
+    heads_axes = rules.assignment("heads")
+    tp_shards = 1
+    for axis_name in (
+        heads_axes if isinstance(heads_axes, tuple) else (heads_axes,)
+    ):
+        if axis_name:
+            tp_shards *= shape.get(axis_name, 1)
+    local_heads = num_heads // max(tp_shards, 1)
+    return local_heads % sp_size == 0
+
+
 def sharded_attention(q, k, v, *, causal: bool,
                       mask: Optional[jnp.ndarray] = None,
                       rules: ShardingRules = DEFAULT_RULES, mesh=None,
@@ -199,14 +230,7 @@ def sharded_attention(q, k, v, *, causal: bool,
 
         batch_axes = rules.assignment("batch")
         heads_axes = rules.assignment("heads")
-        tp_shards = 1
-        for axis_name in (
-            heads_axes if isinstance(heads_axes, tuple) else (heads_axes,)
-        ):
-            if axis_name:
-                tp_shards *= dict(mesh.shape).get(axis_name, 1)
-        local_heads = q.shape[2] // max(tp_shards, 1)
-        if local_heads % sp_size == 0:
+        if ulysses_eligible(q.shape[2], mesh, rules):
             spec = PartitionSpec(
                 batch_axes, mesh_lib.AXIS_SP, heads_axes, None
             )

@@ -68,6 +68,20 @@ def _check_transformer(report, mesh_sizes, *, pipeline: bool,
         cfg = cfg.scaled(ulysses_sp=True)
     mesh = parallel.MeshSpec(mesh_sizes).build()
     report["mesh"] = {k: v for k, v in mesh.shape.items() if v > 1}
+    if ulysses:
+        # This mode exists to prove the all-to-all path; an ineligible
+        # mesh would silently run the ring instead (ADVICE r4).
+        from cloud_tpu.models import layers as layers_lib
+
+        report["ulysses_eligible"] = layers_lib.ulysses_eligible(
+            cfg.num_heads, mesh, rules
+        )
+        if not report["ulysses_eligible"]:
+            raise RuntimeError(
+                f"ulysses mode mesh {mesh_sizes} is not Ulysses-eligible "
+                f"for {cfg.num_heads} heads — it would test the ring "
+                "fallback, not the all-to-all path"
+            )
     logical_axes = transformer.param_logical_axes(cfg)
 
     # Batch rows shard over the "batch" logical axes (dp x fsdp).  Each
@@ -161,45 +175,38 @@ def run_selfcheck() -> dict:
     )
 
     mode = os.environ.get("CLOUD_TPU_SELFCHECK_MODE", "basic")
-    if mode == "transformer":
-        report["phase"] = "transformer_step"
+    # Model-parallel modes and their mesh from the job's device count.
+    # 'ulysses': sp is PINNED to 2 (not dc//2): TINY has 4 heads, tp=2 ->
+    # 2 local heads, and Ulysses requires local_heads % sp == 0 — sp=4
+    # would silently take the ring fallback, the exact trap ADVICE r4
+    # found in the unit test.  tp is innermost and sp next, so on
+    # 2-device processes the sp=2 partners (device stride 2) still live
+    # in different processes: both all-to-alls cross the boundary.
+    model_parallel_meshes = {
+        "transformer": {"fsdp": jax.device_count() // 2, "tp": 2},
+        "pp": {"pp": jax.device_count() // 2, "tp": 2},
+        "tp": {"fsdp": jax.device_count() // 4, "tp": 4},
+        "sp": {"sp": jax.device_count() // 2, "tp": 2},
+        "ulysses": {"fsdp": jax.device_count() // 4, "sp": 2, "tp": 2},
+    }
+    if mode in model_parallel_meshes:
+        sizes = model_parallel_meshes[mode]
+        if min(sizes.values()) < 1:
+            # These modes are env-selected and may be pointed at a rig too
+            # small for their mesh; report that clearly instead of letting
+            # MeshSpec.build die on a zero-size axis (ADVICE r4).
+            report["phase"] = "mesh_too_small"
+            report["ok"] = False
+            report["error"] = (
+                f"mode {mode!r} computed mesh {sizes} from "
+                f"device_count={jax.device_count()}: every axis must be "
+                ">= 1; run this mode on a rig with more devices"
+            )
+            return report
+        report["phase"] = f"{mode}_step"
         _check_transformer(
-            report, {"fsdp": jax.device_count() // 2, "tp": 2},
-            pipeline=False,
-        )
-        report["phase"] = "done"
-        return report
-    if mode == "pp":
-        report["phase"] = "pp_step"
-        _check_transformer(
-            report, {"pp": jax.device_count() // 2, "tp": 2}, pipeline=True
-        )
-        report["phase"] = "done"
-        return report
-    if mode == "tp":
-        report["phase"] = "tp_step"
-        _check_transformer(
-            report, {"fsdp": jax.device_count() // 4, "tp": 4},
-            pipeline=False,
-        )
-        report["phase"] = "done"
-        return report
-    if mode == "sp":
-        report["phase"] = "sp_step"
-        _check_transformer(
-            report, {"sp": jax.device_count() // 2, "tp": 2},
-            pipeline=False,
-        )
-        report["phase"] = "done"
-        return report
-    if mode == "ulysses":
-        # sp=2 x tp=2 over 2-device processes: both all-to-alls (seq->
-        # heads and back) cross the process boundary.  TINY has 4 heads,
-        # tp=2 -> 2 local heads, divisible by sp=2.
-        report["phase"] = "ulysses_step"
-        _check_transformer(
-            report, {"sp": jax.device_count() // 2, "tp": 2},
-            pipeline=False, ulysses=True,
+            report, sizes,
+            pipeline=(mode == "pp"), ulysses=(mode == "ulysses"),
         )
         report["phase"] = "done"
         return report

@@ -37,6 +37,10 @@ class CheckpointManager:
             ),
         )
 
+    @property
+    def directory(self) -> str:
+        return self._directory
+
     def save(self, step: int, state: Any) -> bool:
         import orbax.checkpoint as ocp
 
@@ -102,7 +106,19 @@ def resume_trainer_state(trainer, manager: CheckpointManager, *,
         )
         restored = manager.restore(latest, template=template)
         trainer.state = restored.replace(rng=current.rng)
-        logger.info("resumed from checkpoint step %d", latest)
+        if int(current.step) == 0:
+            # A resume REPLACING a step-0 init is either the intended
+            # preemption recovery or a reused directory silently hijacking
+            # a fresh experiment (ADVICE r4) — loud enough to notice,
+            # with the opt-out spelled out.
+            logger.warning(
+                "resumed from checkpoint step %d in %r, REPLACING this "
+                "run's fresh step-0 state; if this is a new experiment "
+                "reusing an old directory, pass resume=False (or clear "
+                "the directory)", latest, manager.directory,
+            )
+        else:
+            logger.info("resumed from checkpoint step %d", latest)
         return True
     except Exception:  # noqa: BLE001 — fresh start beats a dead job
         logger.exception(

@@ -1281,7 +1281,13 @@ class TestUlyssesAttention:
         return cfg, mesh, params, jnp.asarray(tokens)
 
     def test_matches_dense_forward_and_grad(self):
-        cfg, mesh, params, tokens = self._setup(sp=4, tp=2)
+        # sp=2 x tp=2: TINY has 4 heads -> 2 local heads, divisible by
+        # sp=2, so the Ulysses path REALLY runs (ADVICE r4: sp=4/tp=2 made
+        # every grad assertion here silently test the ring fallback).
+        from cloud_tpu.models import layers as layers_lib
+
+        cfg, mesh, params, tokens = self._setup(sp=2, tp=2)
+        assert layers_lib.ulysses_eligible(cfg.num_heads, mesh)
 
         def loss(p, cfg_, mesh_):
             logits, _ = transformer.apply(p, tokens, cfg_, mesh=mesh_)
@@ -1292,9 +1298,13 @@ class TestUlyssesAttention:
             lambda p: loss(p, dense_cfg, None)
         )(params)
         with parallel.use_mesh(mesh):
-            got, got_grads = jax.jit(
-                jax.value_and_grad(lambda p: loss(p, cfg, mesh))
-            )(params)
+            jitted = jax.jit(jax.value_and_grad(lambda p: loss(p, cfg, mesh)))
+            # The compiled module must contain the seq<->head all-to-alls
+            # (fwd + bwd) — proof the Ulysses path was taken, not the ring
+            # (whose signature is collective-permute).
+            hlo = jitted.lower(params).compile().as_text()
+            assert "all-to-all" in hlo
+            got, got_grads = jitted(params)
         np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
         for g, w in zip(
             jax.tree_util.tree_leaves(got_grads),
