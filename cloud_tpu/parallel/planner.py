@@ -135,14 +135,22 @@ def plan_mesh(
     semantics: ``worker_count`` *additional* replicas of the slice, so the
     job spans ``worker_count + 1`` slices).  ``num_devices`` overrides the
     chip count for local/virtual runs (tests, CPU dry-runs) where no
-    MachineConfig exists.
+    MachineConfig exists; combined with ``worker_count`` it plans a
+    multi-slice job over virtual devices (``num_devices`` total chips
+    split evenly into ``worker_count + 1`` slices), so the dp-over-DCN
+    rule below is exercisable without TPU hardware.
     """
     hints = hints or ParallelismHints()
 
     if num_devices is not None:
-        chips_per_slice = num_devices
+        num_slices = worker_count + 1
+        if num_devices % num_slices:
+            raise ValueError(
+                f"num_devices={num_devices} not divisible into "
+                f"{num_slices} slices"
+            )
+        chips_per_slice = num_devices // num_slices
         hosts_per_slice = 1
-        num_slices = 1
     elif chief_config is not None and chief_config.is_tpu():
         topo = chief_config.tpu_topology()
         chips_per_slice = topo.chips
