@@ -68,6 +68,14 @@ class TransformerConfig:
     #: indivisible configs silently use the ring.  Mutually exclusive
     #: with zigzag_sp.
     ulysses_sp: bool = False
+    #: Compute the training loss with the fused linear cross-entropy
+    #: (ops/fused_cross_entropy.py): the [B, T, V] logits tensor and its
+    #: log-softmax residual are never materialized — the vocab is scanned
+    #: in chunks with an online logsumexp, and the backward recomputes
+    #: chunk logits.  Saves ~2*B*T*V*4 bytes of HBM at the cost of one
+    #: extra head matmul; the win grows with vocab_size and seq_len.
+    #: Training only — apply()/generation still produce real logits.
+    fused_ce: bool = False
 
     def scaled(self, **kw) -> "TransformerConfig":
         return dataclasses.replace(self, **kw)
@@ -289,6 +297,27 @@ def apply(
     ``zigzag_indices(T, sp)[j]``) — ``loss_fn`` accounts for it; callers
     reading logits directly must gather through the inverse permutation.
     """
+    x, aux = apply_hidden(params, tokens, config, rules=rules, mesh=mesh)
+    logits = lm_logits(params, x, config)
+    logits = shard_constraint(logits, "batch", "seq", "vocab", rules=rules,
+                              mesh=mesh)
+    return logits, aux
+
+
+def apply_hidden(
+    params,
+    tokens: jnp.ndarray,
+    config: TransformerConfig,
+    *,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward pass up to the final norm: tokens -> (hidden [B, T, D], aux).
+
+    The pre-head half of :func:`apply`, exposed so the fused
+    cross-entropy loss (``config.fused_ce``) can consume hidden states
+    without the head projection ever materializing [B, T, V] logits.
+    """
     mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
     b, t = tokens.shape
     zigzag = _zigzag_active(config, mesh)
@@ -333,19 +362,38 @@ def apply(
         )
 
     x = layers.rmsnorm_apply(params["ln_f"], x)
-    logits = lm_logits(params, x, config)
-    logits = shard_constraint(logits, "batch", "seq", "vocab", rules=rules, mesh=mesh)
-    return logits, aux
+    return x, aux
+
+
+def head_table(params, config: TransformerConfig):
+    """``(table, layout)`` of the vocabulary projection — THE tying
+    decision, single-sourced for :func:`lm_logits` (apply/generation)
+    and the fused-CE loss so the two can't drift.  Layout "vd" = tied
+    embedding table [V, D] (logits = x @ table^T); "dv" = dense head
+    kernel [D, V]."""
+    if config.tied_embeddings:
+        return params["embed"]["table"], "vd"
+    head = params["head"]
+    extra = set(head) - {"kernel"}
+    if extra:
+        # A bias (or any new head param) would be silently dropped by a
+        # bare-table consumer; fail loudly instead.
+        raise NotImplementedError(
+            f"head has params beyond 'kernel' ({sorted(extra)}); "
+            "head_table/fused_ce support bias-free heads only"
+        )
+    return head["kernel"], "dv"
 
 
 def lm_logits(params, x, config: TransformerConfig) -> jnp.ndarray:
-    """Final vocabulary projection in f32: the dedicated head kernel, or
-    the transposed token-embedding table under ``tied_embeddings`` —
-    shared with the generation path so tying can't drift between them."""
-    if config.tied_embeddings:
-        table = params["embed"]["table"].astype(jnp.float32)
-        return jnp.einsum("...d,vd->...v", x.astype(jnp.float32), table)
-    return layers.dense_apply(params["head"], x, dtype=jnp.float32)
+    """Final vocabulary projection in f32 (tying via :func:`head_table`,
+    shared with the generation path and the fused-CE loss)."""
+    table, layout = head_table(params, config)
+    table = table.astype(jnp.float32)
+    x = x.astype(jnp.float32)
+    if layout == "vd":
+        return jnp.einsum("...d,vd->...v", x, table)
+    return jnp.einsum("...d,dv->...v", x, table)
 
 
 def loss_fn(
@@ -360,7 +408,12 @@ def loss_fn(
     "loss_mask" [B, T], gating the loss at each TARGET position)."""
     tokens = batch["tokens"]
     mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
-    logits, aux = apply(params, tokens, config, rules=rules, mesh=mesh)
+    if config.fused_ce:
+        hidden, aux = apply_hidden(params, tokens, config, rules=rules,
+                                   mesh=mesh)
+        logits = None
+    else:
+        logits, aux = apply(params, tokens, config, rules=rules, mesh=mesh)
     mask = batch.get("loss_mask")
     t = tokens.shape[1]
 
@@ -382,9 +435,21 @@ def loss_fn(
         weights = weights * jnp.take(
             mask.astype(jnp.float32), target_idx, axis=1
         )
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
-    weights = jnp.broadcast_to(weights, nll.shape)
-    ce = jnp.sum(nll * weights) / jnp.clip(jnp.sum(weights), 1.0)
+    if config.fused_ce:
+        from cloud_tpu.ops.fused_cross_entropy import (
+            fused_linear_cross_entropy,
+        )
+
+        table, layout = head_table(params, config)
+        ce = fused_linear_cross_entropy(
+            hidden, table, targets, table_layout=layout, weights=weights,
+        )
+    else:
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            log_probs, targets[..., None], axis=-1
+        )[..., 0]
+        weights = jnp.broadcast_to(weights, nll.shape)
+        ce = jnp.sum(nll * weights) / jnp.clip(jnp.sum(weights), 1.0)
     loss = ce + aux
     return loss, {"loss": loss, "ce": ce, "aux": aux}

@@ -8,6 +8,7 @@ is automatic.
 """
 
 from cloud_tpu.ops.flash_attention import flash_attention
+from cloud_tpu.ops.fused_cross_entropy import fused_linear_cross_entropy
 from cloud_tpu.ops.group_norm import group_norm
 
-__all__ = ["flash_attention", "group_norm"]
+__all__ = ["flash_attention", "fused_linear_cross_entropy", "group_norm"]

@@ -105,8 +105,8 @@ def _driver_active(bench) -> bool:
     return (time.time() - started) < max(2 * bench.TOTAL_BUDGET_S, 3600)
 
 
-def _last_ab_line(stdout):
-    """Last bert_opt_ab JSON line in a child's stdout (one is printed per
+def _last_ab_line(stdout, phase):
+    """Last ``phase`` JSON line in a child's stdout (one is printed per
     completed variant, so the last is the most complete), or None."""
     if isinstance(stdout, bytes):
         stdout = stdout.decode("utf-8", "replace")
@@ -116,7 +116,7 @@ def _last_ab_line(stdout):
             cand = json.loads(line)
         except ValueError:
             continue
-        if isinstance(cand, dict) and cand.get("phase") == "bert_opt_ab":
+        if isinstance(cand, dict) and cand.get("phase") == phase:
             ab_line = cand
     return ab_line
 
@@ -202,6 +202,70 @@ def _ab_main() -> int:
     return 0
 
 
+def _ab_fused_ce_main() -> int:
+    """CloudLM fused-vs-plain cross-entropy A/B on the device.
+
+    GPT-2-small-shaped config (12L x 768d, V=32k, tied head) at b4 x
+    T1024 bf16: the scale where the [B, T, V] logits tensor and its
+    log-softmax residual (~0.5 GiB together) start to matter.  Prints one
+    JSON line per completed variant (partial-salvage contract).
+    """
+    import functools
+
+    import jax
+    import numpy as np
+    import optax
+
+    sys.path.insert(0, REPO)
+    from cloud_tpu.models import transformer
+    from cloud_tpu.training import train as train_lib
+    from cloud_tpu.utils.benchmarking import chain_then_read_throughput
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"phase": "lm_fused_ce_ab", "ok": False,
+                          "error": "backend is not tpu"}), flush=True)
+        return 1
+
+    b, t = 4, 1024
+    base = transformer.SMALL.scaled(tied_embeddings=True)
+    rng = np.random.default_rng(0)
+    batch = jax.device_put({
+        "tokens": rng.integers(1, base.vocab_size, (b, t)).astype(np.int32),
+    })
+    out = {"phase": "lm_fused_ce_ab", "ok": True, "ab": {},
+           "batch": b, "seq": t, "vocab": base.vocab_size}
+    for name, cfg in (
+        ("plain", base), ("fused_ce", base.scaled(fused_ce=True)),
+    ):
+        tx = optax.adamw(1e-4)
+        state = train_lib.create_sharded_state(
+            jax.random.PRNGKey(0),
+            functools.partial(transformer.init, config=cfg), tx, mesh=None,
+        )
+        step = train_lib.make_train_step(
+            functools.partial(transformer.loss_fn, config=cfg, mesh=None),
+            tx,
+        )
+        compiled = step.lower(state, batch).compile()
+        mem = None
+        try:
+            mem = int(
+                compiled.memory_analysis().temp_size_in_bytes
+            )
+        except Exception:  # noqa: BLE001 — context only
+            pass
+        steps_per_sec = chain_then_read_throughput(
+            compiled, state, batch, warmup=2, iters=8
+        )
+        entry = {"steps_per_sec": round(steps_per_sec, 3),
+                 "ms_per_step": round(1000.0 / steps_per_sec, 3)}
+        if mem:
+            entry["temp_bytes"] = mem
+        out["ab"][name] = entry
+        print(json.dumps(out), flush=True)
+    return 0
+
+
 # --------------------------------------------------------------------------
 # Daemon loop.
 
@@ -262,30 +326,36 @@ def _cycle(bench, state) -> bool:
         _log(f"no headline this cycle ({err or 'child died'}); "
              f"errors: {'; '.join(errors)[:300]}")
 
-    # Optimizer-state A/B — independent child so its hang can't sink the
-    # headline above (already written).
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--ab"],
-            capture_output=True, text=True, timeout=AB_TIMEOUT_S, cwd=REPO,
-        )
-        ab_line = _last_ab_line(proc.stdout)
-        if ab_line and ab_line.get("ok"):
-            _append_record(bench, {"source": "in_round_daemon_ab",
-                                   "kind": "bert_opt_ab", **ab_line})
-            _log(f"captured bert_opt_ab: {json.dumps(ab_line.get('ab'))}")
-        else:
-            tail = (proc.stderr or proc.stdout or "").strip()[-200:]
-            _log(f"ab child no result (rc={proc.returncode}, tail={tail!r})")
-    except subprocess.TimeoutExpired as exc:
-        ab_line = _last_ab_line(exc.stdout)
-        if ab_line:
-            _append_record(bench, {"source": "in_round_daemon_ab",
-                                   "kind": "bert_opt_ab", "partial": True,
-                                   **ab_line})
-            _log("ab child timed out; partial variants salvaged")
-        else:
-            _log("ab child timed out with no salvageable line")
+    # A/B children — each independent so a hang can't sink the headline
+    # above (already written) or the other A/B.
+    for flag, phase in (
+        ("--ab", "bert_opt_ab"),
+        ("--ab-fused-ce", "lm_fused_ce_ab"),
+    ):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), flag],
+                capture_output=True, text=True, timeout=AB_TIMEOUT_S,
+                cwd=REPO,
+            )
+            ab_line = _last_ab_line(proc.stdout, phase)
+            if ab_line and ab_line.get("ok"):
+                _append_record(bench, {"source": "in_round_daemon_ab",
+                                       "kind": phase, **ab_line})
+                _log(f"captured {phase}: {json.dumps(ab_line.get('ab'))}")
+            else:
+                tail = (proc.stderr or proc.stdout or "").strip()[-200:]
+                _log(f"{phase} child no result (rc={proc.returncode}, "
+                     f"tail={tail!r})")
+        except subprocess.TimeoutExpired as exc:
+            ab_line = _last_ab_line(exc.stdout, phase)
+            if ab_line:
+                _append_record(bench, {"source": "in_round_daemon_ab",
+                                       "kind": phase, "partial": True,
+                                       **ab_line})
+                _log(f"{phase} child timed out; partial variants salvaged")
+            else:
+                _log(f"{phase} child timed out with no salvageable line")
     return captured
 
 
@@ -311,6 +381,8 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--ab-fused-ce" in sys.argv:
+        sys.exit(_ab_fused_ce_main())
     if "--ab" in sys.argv:
         sys.exit(_ab_main())
     sys.exit(main())

@@ -45,3 +45,26 @@ if "jax" in sys.modules:
     if _cache_dir != "off":
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy compile-bound or multi-process test; skipped locally "
+        "unless CLOUD_TPU_RUN_SLOW=1 (CI always sets it — no coverage "
+        "loss, just a faster local iteration loop; VERDICT r4 next #8)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    if os.environ.get("CLOUD_TPU_RUN_SLOW") == "1":
+        return
+    skip = pytest.mark.skip(
+        reason="slow test skipped locally; set CLOUD_TPU_RUN_SLOW=1 "
+        "(CI always runs these)"
+    )
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

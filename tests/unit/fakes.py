@@ -1,0 +1,33 @@
+"""THE shared fake GcpApiSession recorder.
+
+Every network seam in the framework takes a session-like object
+(utils/api_client.GcpApiSession); the unit files used to each carry
+their own near-identical copy, which drift independently when the real
+session's surface changes.  One recorder here, signature-pinned to the
+real client by test_wire_schemas.TestFakeSessionConformance.
+"""
+
+
+class RecordingSession:
+    """Records ``(method, url, body, params)``; returns scripted
+    responses in order (then ``{}``, or ``get_default`` for GETs)."""
+
+    def __init__(self, responses=None, *, get_default=None):
+        self.calls = []
+        self.responses = list(responses or [])
+        self._get_default = {} if get_default is None else get_default
+
+    def _next(self, default):
+        return self.responses.pop(0) if self.responses else default
+
+    def post(self, url, body=None, params=None):
+        self.calls.append(("POST", url, body, params))
+        return self._next({})
+
+    def get(self, url, params=None):
+        self.calls.append(("GET", url, None, params))
+        return self._next(self._get_default)
+
+    def delete(self, url):
+        self.calls.append(("DELETE", url, None, None))
+        return self._next({})

@@ -31,6 +31,7 @@ def load_module(path, name):
 
 
 class TestGoldenWorkloads:
+    @pytest.mark.slow
     def test_long_context_ring_example_trains(self):
         mod = load_module(
             os.path.join(EXAMPLES, "long_context_ring_attention.py"),
@@ -38,6 +39,7 @@ class TestGoldenWorkloads:
         )
         mod.main()  # asserts loss improvement internally (sp=4 mesh)
 
+    @pytest.mark.slow
     def test_generate_text_example(self):
         mod = load_module(
             os.path.join(EXAMPLES, "generate_text.py"), "ex_generate"

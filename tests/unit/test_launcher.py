@@ -172,31 +172,18 @@ class TestBuildContext:
         assert os.path.isfile(os.path.join(ctx, "cloud_tpu", "core", "run.py"))
 
 
-class FakeSession:
-    """Records requests; returns canned responses (reference mocked
-    discovery.build the same way, deploy_test.py:49-84)."""
+from fakes import RecordingSession
+
+
+class FakeSession(RecordingSession):
+    """Shared recorder with canned responses (reference mocked
+    discovery.build the same way, deploy_test.py:49-84).  GETs default
+    to a READY node: deploy_job's READY-await polls with a REAL
+    time.sleep when called through run(), so a {} default makes
+    run()-level tests spin the full 40x10s provisioning budget."""
 
     def __init__(self, responses=None):
-        self.calls = []
-        self.responses = list(responses or [])
-
-    def _next(self, default):
-        return self.responses.pop(0) if self.responses else default
-
-    def post(self, url, body=None, params=None):
-        self.calls.append(("POST", url, body, params))
-        return self._next({})
-
-    def get(self, url, params=None):
-        self.calls.append(("GET", url, None, params))
-        # Default to a READY node: deploy_job's READY-await polls with a
-        # REAL time.sleep when called through run(), so a {} default makes
-        # run()-level tests spin the full 40x10s provisioning budget.
-        return self._next({"state": "READY"})
-
-    def delete(self, url):
-        self.calls.append(("DELETE", url, None, None))
-        return self._next({})
+        super().__init__(responses, get_default={"state": "READY"})
 
 
 class TestDeploy:

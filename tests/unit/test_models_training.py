@@ -622,6 +622,7 @@ class TestMnist:
 
 
 class TestResnet:
+    @pytest.mark.slow
     def test_forward_and_one_step(self):
         cfg = resnet.RESNET50_CIFAR
         params = resnet.init(jax.random.PRNGKey(0), cfg)
@@ -1009,6 +1010,7 @@ class TestCheckpoint:
         )
         mgr.close()
 
+    @pytest.mark.slow
     def test_restore_directly_into_sharded_layout(self, tmp_path):
         """Pod resume: a checkpoint saved from a sharded mesh restores
         STRAIGHT into the target shardings (template = ShapeDtypeStruct +

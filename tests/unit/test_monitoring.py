@@ -77,13 +77,7 @@ class TestRegistry:
         )
 
 
-class FakeSession:
-    def __init__(self):
-        self.calls = []
-
-    def post(self, url, body=None, params=None):
-        self.calls.append((url, body))
-        return {}
+from fakes import RecordingSession as FakeSession
 
 
 class TestCloudMonitoringExporter:
@@ -132,12 +126,12 @@ class TestCloudMonitoringExporter:
         exp.export(snap)
         exp.export(snap)
         descriptor_calls = [
-            c for c in session.calls if c[0].endswith("metricDescriptors")
+            c for c in session.calls if c[1].endswith("metricDescriptors")
         ]
-        series_calls = [c for c in session.calls if c[0].endswith("timeSeries")]
+        series_calls = [c for c in session.calls if c[1].endswith("timeSeries")]
         assert len(descriptor_calls) == 1  # deduped
         assert len(series_calls) == 2
-        assert descriptor_calls[0][1]["valueType"] == "INT64"
+        assert descriptor_calls[0][2]["valueType"] == "INT64"
 
     def test_empty_snapshot_sends_nothing(self):
         exp, session = self._exporter()
@@ -187,7 +181,7 @@ class TestExporterLifecycle:
         assert exporter_lib._final_flush is None
         assert not exporter_lib._started
         flushed = [
-            body for _, body in session.calls
+            body for _, _, body, _ in session.calls
             if any(
                 "lifecycle/steps" in ts["metric"]["type"]
                 for ts in body.get("timeSeries", [])
@@ -400,7 +394,7 @@ class TestTrainerIntegration:
         )
         exp.export(monitoring.snapshot())
         series_calls = [
-            body for url, body in session.calls if url.endswith("timeSeries")
+            body for _, url, body, _ in session.calls if url.endswith("timeSeries")
         ]
         assert series_calls
         types = {

@@ -205,6 +205,7 @@ class TestRingWithKernelBlocks:
             np.asarray(ring(q, k, v)), np.asarray(expected), atol=2e-5
         )
 
+    @pytest.mark.slow
     def test_gradients_flow_through_merge(self):
         """d(loss)/d(q,k,v) through the kernel-block ring == dense grads
         (the lse merge must backpropagate exactly)."""
@@ -390,3 +391,114 @@ class TestTPUCompile:
         )
         assert result.returncode == 0, result.stdout + result.stderr
         assert "SKIP" not in result.stdout, result.stdout
+
+
+class TestFusedCrossEntropy:
+    """ops/fused_cross_entropy: chunked online-logsumexp CE must match the
+    naive logits+log_softmax path exactly (value and grads), across both
+    table layouts, non-dividing chunk sizes, masks, and bf16 inputs."""
+
+    def _naive(self, x, table, targets, layout="vd", weights=None):
+        w_t = table.T if layout == "vd" else table
+        logits = x.astype(jnp.float32) @ w_t.astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+        if weights is None:
+            return jnp.mean(nll)
+        w = jnp.broadcast_to(weights.astype(jnp.float32), nll.shape)
+        return jnp.sum(nll * w) / jnp.clip(jnp.sum(w), 1.0)
+
+    def _setup(self):
+        from cloud_tpu.ops.fused_cross_entropy import (
+            fused_linear_cross_entropy,
+        )
+
+        rng = np.random.default_rng(0)
+        d, v = 16, 37  # v deliberately not a multiple of any chunk below
+        x = jnp.asarray(rng.normal(size=(3, 4, d)), jnp.float32)
+        table = jnp.asarray(rng.normal(size=(v, d)), jnp.float32) * 0.5
+        targets = jnp.asarray(rng.integers(0, v, (3, 4)))
+        weights = jnp.asarray(rng.integers(0, 2, (3, 4)), jnp.float32)
+        return fused_linear_cross_entropy, x, table, targets, weights
+
+    @pytest.mark.parametrize("chunk", [8, 16, 64])
+    @pytest.mark.parametrize("layout", ["vd", "dv"])
+    def test_matches_naive_value_and_grads(self, chunk, layout):
+        fused, x, table, targets, weights = self._setup()
+        tbl = table if layout == "vd" else table.T
+
+        def f(x, t):
+            return fused(x, t, targets, table_layout=layout,
+                         chunk_size=chunk, weights=weights)
+
+        def g(x, t):
+            return self._naive(x, t, targets, layout, weights)
+
+        v1, grads1 = jax.value_and_grad(f, argnums=(0, 1))(x, tbl)
+        v2, grads2 = jax.value_and_grad(g, argnums=(0, 1))(x, tbl)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+        for a, b in zip(grads1, grads2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
+
+    def test_bf16_inputs_f32_compute(self):
+        fused, x, table, targets, _ = self._setup()
+        xb = x.astype(jnp.bfloat16)
+        got = float(fused(xb, table, targets, chunk_size=8))
+        want = float(self._naive(xb, table, targets))
+        assert abs(got - want) / max(abs(want), 1e-6) < 1e-2
+        grad = jax.grad(
+            lambda x: fused(x, table, targets, chunk_size=8)
+        )(xb)
+        assert grad.dtype == jnp.bfloat16
+
+    def test_loss_fn_fused_matches_plain(self):
+        """End to end through CloudLM: config.fused_ce flips the loss to
+        the fused path with identical value and gradients (both head
+        layouts — tied table [V,D] and dense head kernel [D,V])."""
+        import functools
+
+        from cloud_tpu.models import transformer
+
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(1, 255, (2, 16)).astype(np.int32))
+        mask = jnp.asarray(rng.integers(0, 2, (2, 16)).astype(np.int32))
+        for tied in (False, True):
+            cfg = transformer.TINY.scaled(
+                dtype=jnp.float32, num_layers=2, tied_embeddings=tied
+            )
+            params = transformer.init(jax.random.PRNGKey(0), cfg)
+            batch = {"tokens": tokens, "loss_mask": mask}
+            v1, g1 = jax.value_and_grad(
+                lambda p: transformer.loss_fn(p, batch, cfg, mesh=None)[0]
+            )(params)
+            v2, g2 = jax.value_and_grad(
+                lambda p: transformer.loss_fn(
+                    p, batch, cfg.scaled(fused_ce=True), mesh=None
+                )[0]
+            )(params)
+            np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+            for a, b in zip(
+                jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+                )
+
+    def test_no_full_logits_in_fused_hlo(self):
+        """The point of the op: no [N, V] tensor may appear in the
+        compiled forward+backward module."""
+        fused, x, table, targets, _ = self._setup()
+        big_v, d = 4096, 16
+        rng = np.random.default_rng(1)
+        xb = jnp.asarray(rng.normal(size=(8, d)), jnp.float32)
+        tbl = jnp.asarray(rng.normal(size=(big_v, d)), jnp.float32)
+        tg = jnp.asarray(rng.integers(0, big_v, (8,)))
+        jitted = jax.jit(jax.grad(
+            lambda x, t: fused(x, t, tg, chunk_size=512)
+        ))
+        hlo = jitted.lower(xb, tbl).compile().as_text()
+        # Neither orientation of a full logits tensor may exist.
+        assert f"8,{big_v}" not in hlo
+        assert f"{big_v},8" not in hlo

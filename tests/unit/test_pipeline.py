@@ -92,6 +92,7 @@ class TestPipelineSchedule:
             )
 
 
+@pytest.mark.slow
 class TestPartitionedKernelInPipelineRegion:
     """The flash kernel must run INSIDE the pp-manual region via
     custom_partitioning — no O(T^2) fallback, no nested shard_map

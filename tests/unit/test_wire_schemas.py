@@ -5,11 +5,14 @@ request shapes — a field-name drift (``runtime_version`` for
 ``runtimeVersion``) would pass every test and only fail against the live
 service.  The reference's defense was a vendored discovery document
 asserted at request-build time (``optimizer_client.py:395-402``); here the
-same pin is two trimmed vendored schemas —
-``cloud_tpu/core/api/tpu_v2.json`` (TPU VM v2) and
-``cloud_tpu/tuner/api/vizier_v1.json`` (CAIP Optimizer, trimmed from the
-service's own public discovery doc) — plus a structural validator that
-rejects unknown fields, wrong JSON types, and out-of-enum values.
+same pin is trimmed vendored schemas for EVERY outbound API —
+``cloud_tpu/core/api/tpu_v2.json`` (TPU VM v2),
+``cloud_tpu/core/api/cloudbuild_v1.json`` (Cloud Build),
+``cloud_tpu/core/api/logging_v2.json`` (log streaming),
+``cloud_tpu/monitoring/api/monitoring_v3.json`` (metrics export), and
+``cloud_tpu/tuner/api/vizier_v1.json`` (CAIP Optimizer) — plus a
+structural validator that rejects unknown fields, wrong JSON types, and
+out-of-enum values.
 """
 
 import json
@@ -280,3 +283,148 @@ class TestVizierPins:
                      {"suggestion_count": 1})
         with pytest.raises(AssertionError, match="not in service enum"):
             validate(VIZIER_SCHEMA, "MetricSpec", {"goal": "MINIMISE"})
+
+
+CLOUDBUILD_SCHEMA = json.load(
+    open(os.path.join(REPO, "cloud_tpu", "core", "api", "cloudbuild_v1.json"))
+)
+MONITORING_SCHEMA = json.load(
+    open(os.path.join(
+        REPO, "cloud_tpu", "monitoring", "api", "monitoring_v3.json"
+    ))
+)
+LOGGING_SCHEMA = json.load(
+    open(os.path.join(REPO, "cloud_tpu", "core", "api", "logging_v2.json"))
+)
+
+
+from fakes import RecordingSession as _RecordingSession
+
+
+class TestFakeSessionConformance:
+    """The shared fake must present the real client's surface: a
+    signature drift in GcpApiSession breaks HERE, not silently in four
+    stale per-file copies (the failure mode this pin exists for)."""
+
+    def test_signatures_match_real_session(self):
+        import inspect
+
+        from cloud_tpu.utils import api_client
+
+        for name in ("post", "get", "delete"):
+            real = inspect.signature(getattr(api_client.GcpApiSession, name))
+            fake = inspect.signature(getattr(_RecordingSession, name))
+            assert list(real.parameters) == list(fake.parameters), (
+                f"GcpApiSession.{name} signature drifted from the shared "
+                f"fake: {real} vs {fake}"
+            )
+
+
+class TestCloudBuildPins:
+    """Every Cloud Build request body/URL this framework produces,
+    validated against the service's own (vendored) schema — VERDICT r4
+    next #7, generalizing the Vizier/TPU pins."""
+
+    def _builder(self, session=None, tmpdir="/tmp"):
+        from cloud_tpu.core import containerize
+
+        return containerize.CloudContainerBuilder(
+            "gcr.io/p/img:1", tmpdir, project="p", bucket="b",
+            session=session,
+        )
+
+    def test_build_request_matches_service_schema(self):
+        body = self._builder().build_request("cloud_tpu_build/x.tgz")
+        validate(CLOUDBUILD_SCHEMA, "Build", body)
+
+    def test_urls_match_vendored_methods(self, tmp_path, monkeypatch):
+        (tmp_path / "Dockerfile").write_text("FROM x")
+        session = _RecordingSession([
+            {"metadata": {"build": {"id": "b1"}}},
+            {"status": "SUCCESS"},
+        ])
+        builder = self._builder(session=session, tmpdir=str(tmp_path))
+        monkeypatch.setattr(
+            builder, "_upload_context", lambda: "cloud_tpu_build/x.tgz"
+        )
+        assert builder.get_docker_image() == "gcr.io/p/img:1"
+        (create_m, create_url, create_body, _), (get_m, get_url, _, _) = (
+            session.calls
+        )
+        assert method_for(CLOUDBUILD_SCHEMA, create_m, create_url) == (
+            "builds.create"
+        )
+        assert method_for(CLOUDBUILD_SCHEMA, get_m, get_url) == "builds.get"
+        validate(CLOUDBUILD_SCHEMA, "Build", create_body)
+
+    def test_poll_states_are_service_states(self):
+        import inspect
+
+        from cloud_tpu.core import containerize
+
+        src = inspect.getsource(containerize.CloudContainerBuilder)
+        enum = set(CLOUDBUILD_SCHEMA["schemas"]["Build"]["status"]["enum"])
+        for state in ("SUCCESS", "FAILURE", "INTERNAL_ERROR", "TIMEOUT",
+                      "CANCELLED"):
+            assert state in src and state in enum
+
+
+class TestMonitoringPins:
+    """The exporter's Python wire bodies (the C++ wire client mirrors the
+    same conversion) validated against the Cloud Monitoring v3 schema."""
+
+    SNAPSHOT = {
+        "counters": {"train/steps": 40},
+        "gauges": {"train/loss": 0.25},
+        "distributions": {
+            "train/step_time_ms": {
+                "count": 3,
+                "mean": 1.5,
+                "sum_squared_deviation": 0.5,
+                "buckets": [0, 2, 1, 0],
+            }
+        },
+    }
+
+    def test_bodies_and_urls_match_service(self):
+        from cloud_tpu.monitoring.exporter import CloudMonitoringExporter
+
+        session = _RecordingSession([])
+        exporter = CloudMonitoringExporter(project="p", session=session)
+        exporter.export(self.SNAPSHOT)
+        assert session.calls, "exporter posted nothing"
+        saw_ts = saw_desc = False
+        for method, url, body, _ in session.calls:
+            matched = method_for(MONITORING_SCHEMA, method, url)
+            assert matched in ("timeSeries.create",
+                              "metricDescriptors.create"), url
+            if matched == "timeSeries.create":
+                saw_ts = True
+                validate(MONITORING_SCHEMA, "CreateTimeSeriesRequest", body)
+            else:
+                saw_desc = True
+                validate(MONITORING_SCHEMA, "MetricDescriptor", body)
+        assert saw_ts and saw_desc
+
+    def test_schema_rejects_wrong_kind(self):
+        from cloud_tpu.monitoring.exporter import CloudMonitoringExporter
+
+        session = _RecordingSession([])
+        exporter = CloudMonitoringExporter(project="p", session=session)
+        exporter.export(self.SNAPSHOT)
+        body = next(b for m, u, b, _ in session.calls if "timeSeries" in u)
+        body["timeSeries"][0]["metricKind"] = "SOMETIMES"
+        with pytest.raises(AssertionError, match="not in service enum"):
+            validate(MONITORING_SCHEMA, "CreateTimeSeriesRequest", body)
+
+
+class TestLoggingPins:
+    def test_entries_list_body_matches_service(self):
+        session = _RecordingSession([{"entries": []}])
+        deploy.stream_logs(
+            "job-1", "p", session=session, should_stop=lambda: True,
+            sleep=lambda s: None, out=lambda line: None,
+        )
+        method, url, body, _ = session.calls[0]
+        assert method_for(LOGGING_SCHEMA, method, url) == "entries.list"
+        validate(LOGGING_SCHEMA, "ListLogEntriesRequest", body)
