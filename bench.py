@@ -512,13 +512,62 @@ def _decode_stream(raw) -> str:
     return raw
 
 
+def _hardened_run(argv, *, timeout, env=None, cwd=None):
+    """subprocess.run(capture_output=True, text=True) with a kill that
+    actually lands.
+
+    Observed in-round: a hung-tunnel child spawns helper GRANDCHILDREN
+    that inherit the stdout/stderr pipes; ``subprocess.run``'s timeout
+    kills only the direct child and then blocks forever in the drain
+    waiting for pipe EOF the grandchildren never deliver — the parent
+    wedges despite its timeout (the rounds-3/4 0.0-artifact mechanism,
+    one level up).  Fix: run the child in its OWN SESSION and SIGKILL
+    the whole process group on timeout; if the drain still does not
+    complete promptly, abandon the pipes (partial output is salvaged
+    from the buffers already read).
+    """
+    import signal
+
+    proc = subprocess.Popen(
+        argv,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=cwd,
+        env=env,
+        start_new_session=True,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+        return subprocess.CompletedProcess(argv, proc.returncode,
+                                           stdout, stderr)
+    except subprocess.TimeoutExpired as exc:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            proc.kill()
+        try:
+            stdout, stderr = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            # A double-forked straggler still holds the pipes: abandon
+            # them (fds close with the Popen object) rather than wedge.
+            stdout = _decode_stream(exc.stdout)
+            stderr = _decode_stream(exc.stderr)
+            for stream in (proc.stdout, proc.stderr):
+                try:
+                    stream.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        raise subprocess.TimeoutExpired(
+            argv, timeout, output=stdout, stderr=stderr
+        )
+
+
 def _run_child(mode: str, timeout: float, env=None):
     """Run a child; returns (parsed phase lines, error string or '')."""
     try:
-        proc = subprocess.run(
+        proc = _hardened_run(
             [sys.executable, os.path.abspath(__file__), mode],
-            capture_output=True,
-            text=True,
             timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)),
             env=env,
@@ -527,8 +576,8 @@ def _run_child(mode: str, timeout: float, env=None):
         rc: "int | None" = proc.returncode
         err = ""
     except subprocess.TimeoutExpired as exc:
-        # run() attaches output captured before the kill; under text=True
-        # it has still been observed as bytes — decode defensively.
+        # Partial output captured before the kill; under text=True it has
+        # still been observed as bytes — decode defensively.
         stdout = _decode_stream(exc.stdout)
         stderr = _decode_stream(exc.stderr)
         rc = None

@@ -333,10 +333,9 @@ def _cycle(bench, state) -> bool:
         ("--ab-fused-ce", "lm_fused_ce_ab"),
     ):
         try:
-            proc = subprocess.run(
+            proc = bench._hardened_run(
                 [sys.executable, os.path.abspath(__file__), flag],
-                capture_output=True, text=True, timeout=AB_TIMEOUT_S,
-                cwd=REPO,
+                timeout=AB_TIMEOUT_S, cwd=REPO,
             )
             ab_line = _last_ab_line(proc.stdout, phase)
             if ab_line and ab_line.get("ok"):

@@ -77,7 +77,7 @@ def test_happy_path_single_line(bench, monkeypatch, capsys):
             {"phase": "bert", "ok": True, "extras": {"bert_mfu": 0.40}},
         ))
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 0
     record = _emitted(capsys)
     assert record["value"] == 171.4
@@ -100,7 +100,7 @@ def test_headline_salvaged_from_timed_out_child(bench, monkeypatch, capsys):
             argv, timeout, output=_lines(RESNET_OK).encode()
         )
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 0
     record = _emitted(capsys)
     assert record["value"] == 171.4
@@ -120,7 +120,7 @@ def test_probe_retries_instead_of_burning_attempts(bench, monkeypatch, capsys):
             return _proc(_lines(PROBE_OK))
         return _proc(_lines(RESNET_OK))
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 0
     record = _emitted(capsys)
     assert record["value"] == 171.4
@@ -144,7 +144,7 @@ def test_gn_kernel_disabled_after_headline_less_timeout(bench, monkeypatch,
              "extras": {"group_norm_kernel_used": False}},
         ))
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 0
     record = _emitted(capsys)
     assert record["value"] == 150.0
@@ -167,7 +167,7 @@ def test_corrected_headline_supersedes(bench, monkeypatch, capsys):
              "extras": {"group_norm_kernel_used": False}},
         ))
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 0
     record = _emitted(capsys)
     assert record["value"] == 149.0
@@ -183,7 +183,7 @@ def test_total_failure_emits_structured_zero(bench, monkeypatch, capsys):
     def fake_run(argv, *, timeout, **kwargs):
         raise subprocess.TimeoutExpired(argv, timeout)
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 1
     record = _emitted(capsys)
     assert record["value"] == 0.0
@@ -207,7 +207,7 @@ def test_cpu_fallback_probe_rejected(bench, monkeypatch, capsys):
         children.append(argv)
         return _proc(_lines(RESNET_OK))
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 1
     record = _emitted(capsys)
     assert record["value"] == 0.0
@@ -237,7 +237,7 @@ def test_suspect_headline_retried_with_kernel_off(bench, monkeypatch, capsys):
              "extras": {"group_norm_kernel_used": False}},
         ))
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 0
     record = _emitted(capsys)
     assert record["value"] == 148.0
@@ -303,7 +303,7 @@ def test_daemon_fallback_when_all_probes_fail(bench, monkeypatch, capsys):
     def fake_run(argv, *, timeout, **kwargs):
         raise subprocess.TimeoutExpired(argv, timeout)
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 0
     record = _emitted(capsys)
     assert record["value"] == 168.2  # freshest line with a headline wins
@@ -331,7 +331,7 @@ def test_daemon_fallback_skips_stale_lines(bench, monkeypatch, capsys):
     def fake_run(argv, *, timeout, **kwargs):
         raise subprocess.TimeoutExpired(argv, timeout)
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 1
     assert _emitted(capsys)["value"] == 0.0
 
@@ -351,7 +351,7 @@ def test_driver_headline_preferred_over_daemon(bench, monkeypatch, capsys):
             return _proc(_lines(PROBE_OK))
         return _proc(_lines(RESNET_OK))
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
     assert bench.main() == 0
     record = _emitted(capsys)
     assert record["value"] == 171.4
