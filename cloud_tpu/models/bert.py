@@ -37,6 +37,11 @@ class BertConfig:
     #: classifier).  Active only when a ``dropout_rng`` is passed (the
     #: training path); eval and generation stay deterministic.
     dropout_rate: float = 0.0
+    #: Rematerialize the layer scan: "none" (default — b32xs128 fits
+    #: comfortably and no-remat is fastest), "full", or "dots"
+    #: (layers.remat_wrap docstring).  Long-sequence fine-tunes flip
+    #: this to fit; pure scheduling, numerics identical.
+    remat: str = "none"
 
     @property
     def head_dim(self) -> int:
@@ -155,7 +160,8 @@ def encode(
         return x, None
 
     xs = (params["layers"], layer_rngs) if rate > 0.0 else params["layers"]
-    x, _ = jax.lax.scan(layer_body, x, xs)
+    body = layers.remat_wrap(layer_body, cfg.remat != "none", cfg.remat)
+    x, _ = jax.lax.scan(body, x, xs)
     return x
 
 

@@ -18,6 +18,42 @@ import jax.numpy as jnp
 from cloud_tpu.parallel.sharding import ShardingRules, DEFAULT_RULES, shard_constraint
 
 
+#: Named rematerialization policies for the layer-stack scans.  Memory /
+#: recompute trade-offs on TPU (BASELINE.md "BERT MFU ceiling" — remat
+#: policy on the scan is an ablation axis):
+#:
+#: - "full": ``jax.checkpoint`` saving only the carry — minimum live
+#:   activations (one layer's worth), backward re-runs the whole layer
+#:   including its matmuls (~33% extra MXU FLOPs).
+#: - "dots": save matmul OUTPUTS, recompute elementwise/norm chains —
+#:   the backward never re-runs MXU work; extra memory is the saved
+#:   projections, still far below no-remat's full residual set.  The
+#:   usual best default for HBM-rich chips running compute-bound steps.
+#: - "none": XLA keeps every residual (fastest when it fits).
+REMAT_POLICIES = ("none", "full", "dots")
+
+
+def remat_wrap(body, enabled: bool = True, policy: str = "full"):
+    """Wrap a scan body with the named remat policy (see REMAT_POLICIES).
+
+    A pure scheduling change: loss and gradients are bit-identical across
+    policies (asserted in tests/unit/test_models_training.py); only the
+    memory/recompute trade moves.
+    """
+    if not enabled or policy == "none":
+        return body
+    if policy == "full":
+        return jax.checkpoint(body)
+    if policy == "dots":
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    raise ValueError(
+        f"remat policy must be one of {REMAT_POLICIES}, got {policy!r}"
+    )
+
+
 def dense_axes(in_axis: Optional[str], out_axis: Optional[str],
                use_bias: bool = True):
     """Logical axes for a dense layer's params — the single source of truth

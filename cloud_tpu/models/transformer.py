@@ -47,6 +47,10 @@ class TransformerConfig:
     moe: Optional[moe_lib.MoeConfig] = None  # None -> dense SwiGLU MLP
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    #: Which remat policy when ``remat`` is on: "full" (save carry only)
+    #: or "dots" (save matmul outputs, recompute elementwise — backward
+    #: never re-runs MXU work).  See layers.remat_wrap.
+    remat_policy: str = "full"
     rope_base: float = 10000.0
     #: Microbatch count for pipeline parallelism (pp > 1); None -> pp size.
     #: Bubble fraction is (pp-1)/(M+pp-1), so raise this to amortize it.
@@ -264,7 +268,8 @@ def _pipelined_stack(params, x, config, rules, mesh):
             positions=positions,
         )
 
-    body = jax.checkpoint(pipe_layer) if config.remat else pipe_layer
+    body = layers.remat_wrap(pipe_layer, config.remat,
+                             config.remat_policy)
     x_mbs, aux_mbs = pipeline_lib.pipeline(
         body, params["layers"], (x_mbs, aux_mbs), mesh=mesh
     )
@@ -354,9 +359,8 @@ def apply_hidden(
             )
             return (x, aux), None
 
-        body = layer_body
-        if config.remat:
-            body = jax.checkpoint(layer_body)
+        body = layers.remat_wrap(layer_body, config.remat,
+                                 config.remat_policy)
         (x, aux), _ = jax.lax.scan(
             body, (x, jnp.zeros((), jnp.float32)), params["layers"]
         )

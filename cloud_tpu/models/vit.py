@@ -37,6 +37,8 @@ class ViTConfig:
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    #: "full" or "dots" (layers.remat_wrap docstring).
+    remat_policy: str = "full"
     #: "cls" prepends a learned class token and classifies from it (the
     #: original ViT); "gap" mean-pools patch tokens (no extra token, the
     #: sequence stays a power of two — friendlier shapes on TPU).
@@ -173,7 +175,7 @@ def apply(
                              mesh=mesh)
         return x, None
 
-    body = jax.checkpoint(layer_body) if cfg.remat else layer_body
+    body = layers.remat_wrap(layer_body, cfg.remat, cfg.remat_policy)
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = layers.layernorm_apply(params["ln_f"], x)
     pooled = x[:, 0] if cfg.pooling == "cls" else jnp.mean(x, axis=1)

@@ -1372,3 +1372,70 @@ class TestUlyssesAttention:
         with pytest.raises(ValueError, match="mutually exclusive"):
             with parallel.use_mesh(mesh):
                 transformer.apply(params, tokens, bad, mesh=mesh)
+
+
+class TestRematPolicies:
+    """remat_wrap is a pure scheduling change: loss AND gradients must be
+    identical across none/full/dots on every model that exposes the knob
+    (BASELINE.md 'BERT MFU ceiling' names the scan remat policy as an
+    ablation axis — the ablation is only meaningful if numerics hold)."""
+
+    def test_transformer_policies_identical(self):
+        cfg0 = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(0), cfg0)
+        rng = np.random.default_rng(0)
+        batch = {"tokens": jnp.asarray(
+            rng.integers(1, 255, (2, 16)).astype(np.int32)
+        )}
+        results = {}
+        for name, cfg in {
+            "none": cfg0.scaled(remat=False),
+            "full": cfg0.scaled(remat=True, remat_policy="full"),
+            "dots": cfg0.scaled(remat=True, remat_policy="dots"),
+        }.items():
+            val, grads = jax.value_and_grad(
+                lambda p, c=cfg: transformer.loss_fn(p, batch, c, mesh=None)[0]
+            )(params)
+            results[name] = (float(val), grads)
+        base_val, base_grads = results["none"]
+        for name in ("full", "dots"):
+            val, grads = results[name]
+            np.testing.assert_allclose(val, base_val, rtol=1e-6)
+            for g, b in zip(
+                jax.tree_util.tree_leaves(grads),
+                jax.tree_util.tree_leaves(base_grads),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(b), rtol=1e-5, atol=1e-7
+                )
+
+    def test_bert_policies_identical(self):
+        cfg0 = bert.TINY
+        params = bert.init(jax.random.PRNGKey(0), cfg=cfg0)
+        rng = np.random.default_rng(0)
+        batch = {
+            "tokens": rng.integers(0, 500, (2, 16)).astype(np.int32),
+            "label": rng.integers(0, 2, 2).astype(np.int64),
+        }
+        vals = {}
+        for policy in ("none", "full", "dots"):
+            cfg = dataclasses.replace(cfg0, remat=policy)
+            val, grads = jax.value_and_grad(
+                lambda p, c=cfg: bert.loss_fn(p, batch, cfg=c)[0]
+            )(params)
+            vals[policy] = (float(val), grads)
+        base_val, base_grads = vals["none"]
+        for policy in ("full", "dots"):
+            val, grads = vals[policy]
+            np.testing.assert_allclose(val, base_val, rtol=1e-5)
+            for g, b in zip(
+                jax.tree_util.tree_leaves(grads),
+                jax.tree_util.tree_leaves(base_grads),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(b), rtol=1e-4, atol=1e-6
+                )
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(ValueError, match="remat policy"):
+            layers.remat_wrap(lambda c, x: (c, None), True, "everything")
