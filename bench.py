@@ -423,7 +423,13 @@ def _check_group_norm(extras):
     def loss(x, s, b, use_pallas):
         y = group_norm(x, s, b, num_groups=32, use_pallas=use_pallas,
                        partitioned=False)
-        return jnp.sum(y.astype(jnp.float32) ** 2)
+        # The ResNet headline runs the fused-ReLU epilogue; gate it too.
+        y2 = group_norm(x, s, b, num_groups=32, use_pallas=use_pallas,
+                        partitioned=False, activation="relu")
+        return (
+            jnp.sum(y.astype(jnp.float32) ** 2)
+            + jnp.sum(y2.astype(jnp.float32) ** 2)
+        )
 
     got = jax.jit(jax.value_and_grad(lambda *a: loss(*a, True),
                                      argnums=(0, 1, 2)))(x, s, b)

@@ -42,7 +42,7 @@ from cloud_tpu.ops import dispatch as dispatch_lib
 KERNEL_TRACE_COUNT = 0
 
 
-def _reference(x, scale, bias, num_groups, eps=1e-5):
+def _reference(x, scale, bias, num_groups, eps=1e-5, relu=False):
     """Ground truth (and non-TPU fallback) — mirrors models/resnet.py."""
     b, h, w, c = x.shape
     g = min(num_groups, c)
@@ -54,6 +54,8 @@ def _reference(x, scale, bias, num_groups, eps=1e-5):
     var = jnp.maximum(m2c - m1c * m1c, 0.0)
     y = (xc - m1c) * jax.lax.rsqrt(var + eps)
     y = y.reshape(b, h, w, c) * scale + bias
+    if relu:
+        y = jnp.maximum(y, 0.0)
     return y.astype(x.dtype)
 
 
@@ -69,7 +71,7 @@ def _onehot(c: int, g: int) -> jnp.ndarray:
 
 
 def _fwd_kernel(x_ref, scale_ref, bias_ref, oh_ref, oht_ref, y_ref,
-                mean_ref, rstd_ref, *, eps, hw, cg):
+                mean_ref, rstd_ref, *, eps, hw, cg, relu):
     x = x_ref[0].astype(jnp.float32)
     h, w, c = x.shape
     x2 = x.reshape(hw, c)
@@ -92,13 +94,17 @@ def _fwd_kernel(x_ref, scale_ref, bias_ref, oh_ref, oht_ref, y_ref,
     rstd_c = rstd_g @ oht                           # [1, C]
 
     y = (x2 - mean_c) * rstd_c * scale_ref[...] + bias_ref[...]
+    if relu:
+        # Fused epilogue: the separate XLA relu would cost one more HBM
+        # read+write of the whole activation on a bandwidth-bound model.
+        y = jnp.maximum(y, 0.0)
     y_ref[0] = y.reshape(h, w, c).astype(y_ref.dtype)
     mean_ref[0] = mean_g[0]
     rstd_ref[0] = rstd_g[0]
 
 
-def _bwd_kernel(x_ref, dy_ref, mean_ref, rstd_ref, scale_ref, oh_ref,
-                oht_ref, dx_ref, ds_ref, db_ref, *, hw, cg):
+def _bwd_kernel(x_ref, dy_ref, mean_ref, rstd_ref, scale_ref, bias_ref,
+                oh_ref, oht_ref, dx_ref, ds_ref, db_ref, *, hw, cg, relu):
     x = x_ref[0].astype(jnp.float32)
     dy = dy_ref[0].astype(jnp.float32)
     h, w, c = x.shape
@@ -111,6 +117,11 @@ def _bwd_kernel(x_ref, dy_ref, mean_ref, rstd_ref, scale_ref, oh_ref,
     mean_c = mean_ref[...] @ oht                    # [1, C]
     rstd_c = rstd_ref[...] @ oht                    # [1, C]
     xhat = (x2 - mean_c) * rstd_c
+    if relu:
+        # Recompute the pre-activation sign from the saved stats: the
+        # relu gate zeroes the cotangent where the fused forward clamped.
+        pre = xhat * scale_ref[...] + bias_ref[...]
+        dy2 = jnp.where(pre > 0.0, dy2, 0.0)
     dxh = dy2 * scale_ref[...]
 
     a_c = (jnp.sum(dxh, axis=0, keepdims=True) @ oh) @ oht         # [1, C]
@@ -130,7 +141,7 @@ def _block_specs(b, h, w, c, g):
     return x_spec, vec_spec, oh_spec, oht_spec, stat_spec
 
 
-def _fwd_pallas(x, scale, bias, num_groups, eps, interpret):
+def _fwd_pallas(x, scale, bias, num_groups, eps, interpret, relu=False):
     global KERNEL_TRACE_COUNT
     KERNEL_TRACE_COUNT += 1
     b, h, w, c = x.shape
@@ -139,7 +150,7 @@ def _fwd_pallas(x, scale, bias, num_groups, eps, interpret):
     oh = _onehot(c, g)
     x_spec, vec_spec, oh_spec, oht_spec, stat_spec = _block_specs(b, h, w, c, g)
     y, mean, rstd = pl.pallas_call(
-        functools.partial(_fwd_kernel, eps=eps, hw=hw, cg=cg),
+        functools.partial(_fwd_kernel, eps=eps, hw=hw, cg=cg, relu=relu),
         grid=(b,),
         in_specs=[x_spec, vec_spec, vec_spec, oh_spec, oht_spec],
         out_specs=[x_spec, stat_spec, stat_spec],
@@ -153,7 +164,8 @@ def _fwd_pallas(x, scale, bias, num_groups, eps, interpret):
     return y, mean, rstd
 
 
-def _bwd_pallas(x, dy, mean, rstd, scale, num_groups, interpret):
+def _bwd_pallas(x, dy, mean, rstd, scale, bias, num_groups, interpret,
+                relu=False):
     global KERNEL_TRACE_COUNT
     KERNEL_TRACE_COUNT += 1
     b, h, w, c = x.shape
@@ -163,10 +175,10 @@ def _bwd_pallas(x, dy, mean, rstd, scale, num_groups, interpret):
     x_spec, vec_spec, oh_spec, oht_spec, stat_spec = _block_specs(b, h, w, c, g)
     partial_spec = pl.BlockSpec((1, c), lambda i: (i, 0))
     dx, ds, db = pl.pallas_call(
-        functools.partial(_bwd_kernel, hw=hw, cg=cg),
+        functools.partial(_bwd_kernel, hw=hw, cg=cg, relu=relu),
         grid=(b,),
-        in_specs=[x_spec, x_spec, stat_spec, stat_spec, vec_spec, oh_spec,
-                  oht_spec],
+        in_specs=[x_spec, x_spec, stat_spec, stat_spec, vec_spec, vec_spec,
+                  oh_spec, oht_spec],
         out_specs=[x_spec, partial_spec, partial_spec],
         out_shape=[
             jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -174,25 +186,27 @@ def _bwd_pallas(x, dy, mean, rstd, scale, num_groups, interpret):
             jax.ShapeDtypeStruct((b, c), jnp.float32),
         ],
         interpret=interpret,
-    )(x, dy, mean, rstd, scale.reshape(1, c), oh, oh.T)
+    )(x, dy, mean, rstd, scale.reshape(1, c), bias.reshape(1, c), oh, oh.T)
     return dx, ds, db
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _gn(x, scale, bias, num_groups, eps, interpret):
-    y, _, _ = _fwd_pallas(x, scale, bias, num_groups, eps, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _gn(x, scale, bias, num_groups, eps, interpret, relu=False):
+    y, _, _ = _fwd_pallas(x, scale, bias, num_groups, eps, interpret,
+                          relu=relu)
     return y
 
 
-def _gn_fwd(x, scale, bias, num_groups, eps, interpret):
-    y, mean, rstd = _fwd_pallas(x, scale, bias, num_groups, eps, interpret)
-    return y, (x, mean, rstd, scale)
+def _gn_fwd(x, scale, bias, num_groups, eps, interpret, relu=False):
+    y, mean, rstd = _fwd_pallas(x, scale, bias, num_groups, eps, interpret,
+                                relu=relu)
+    return y, (x, mean, rstd, scale, bias)
 
 
-def _gn_bwd(num_groups, eps, interpret, residuals, dy):
-    x, mean, rstd, scale = residuals
+def _gn_bwd(num_groups, eps, interpret, relu, residuals, dy):
+    x, mean, rstd, scale, bias = residuals
     dx, ds, db = _bwd_pallas(
-        x, dy, mean, rstd, scale, num_groups, interpret
+        x, dy, mean, rstd, scale, bias, num_groups, interpret, relu=relu
     )
     return dx, jnp.sum(ds, axis=0), jnp.sum(db, axis=0)
 
@@ -212,7 +226,7 @@ _gn.defvjp(_gn_fwd, _gn_bwd)
 
 
 @functools.lru_cache(maxsize=None)
-def _cp_fwd_call(num_groups, eps, interpret):
+def _cp_fwd_call(num_groups, eps, interpret, relu=False):
     from jax.experimental.custom_partitioning import (
         SdyShardingRule,
         custom_partitioning,
@@ -220,7 +234,7 @@ def _cp_fwd_call(num_groups, eps, interpret):
 
     def impl(x, scale, bias):
         y, mean, rstd = _fwd_pallas(x, scale, bias, num_groups, eps,
-                                    interpret)
+                                    interpret, relu=relu)
         return y, mean[..., None, None], rstd[..., None, None]
 
     fn = custom_partitioning(impl)
@@ -246,16 +260,16 @@ def _cp_fwd_call(num_groups, eps, interpret):
 
 
 @functools.lru_cache(maxsize=None)
-def _cp_bwd_call(num_groups, interpret):
+def _cp_bwd_call(num_groups, interpret, relu=False):
     from jax.experimental.custom_partitioning import (
         SdyShardingRule,
         custom_partitioning,
     )
 
-    def impl(x, dy, mean4, rstd4, scale):
+    def impl(x, dy, mean4, rstd4, scale, bias):
         dx, ds, db = _bwd_pallas(
-            x, dy, mean4[..., 0, 0], rstd4[..., 0, 0], scale, num_groups,
-            interpret,
+            x, dy, mean4[..., 0, 0], rstd4[..., 0, 0], scale, bias,
+            num_groups, interpret, relu=relu,
         )
         return dx, ds[:, None, None, :], db[:, None, None, :]
 
@@ -270,7 +284,7 @@ def _cp_bwd_call(num_groups, interpret):
         partition=part,
         sharding_rule=SdyShardingRule(
             operand_mappings=(bhwc, bhwc, ("b", "g", "o1", "o2"),
-                              ("b", "g2", "o3", "o4"), ("c",)),
+                              ("b", "g2", "o3", "o4"), ("c",), ("c",)),
             result_mappings=(bhwc, ("b", "o5", "o6", "c"),
                              ("b", "o7", "o8", "c")),
             need_replication_factors=(
@@ -283,9 +297,9 @@ def _cp_bwd_call(num_groups, interpret):
 
 
 @functools.lru_cache(maxsize=None)
-def _gn_partitioned(num_groups, eps, interpret):
-    fwd_call = _cp_fwd_call(num_groups, eps, interpret)
-    bwd_call = _cp_bwd_call(num_groups, interpret)
+def _gn_partitioned(num_groups, eps, interpret, relu=False):
+    fwd_call = _cp_fwd_call(num_groups, eps, interpret, relu)
+    bwd_call = _cp_bwd_call(num_groups, interpret, relu)
 
     @jax.custom_vjp
     def f(x, scale, bias):
@@ -294,11 +308,11 @@ def _gn_partitioned(num_groups, eps, interpret):
 
     def f_fwd(x, scale, bias):
         y, mean4, rstd4 = fwd_call(x, scale, bias)
-        return y, (x, mean4, rstd4, scale)
+        return y, (x, mean4, rstd4, scale, bias)
 
     def f_bwd(res, dy):
-        x, mean4, rstd4, scale = res
-        dx, ds4, db4 = bwd_call(x, dy, mean4, rstd4, scale)
+        x, mean4, rstd4, scale, bias = res
+        dx, ds4, db4 = bwd_call(x, dy, mean4, rstd4, scale, bias)
         # Cross-batch reduction OUTSIDE the cp boundary: GSPMD turns the
         # sharded [B, 1, 1, C] sum into the right collective itself.
         return dx, jnp.sum(ds4, axis=(0, 1, 2)), jnp.sum(db4, axis=(0, 1, 2))
@@ -332,6 +346,7 @@ def group_norm(
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
     partitioned: Optional[bool] = None,
+    activation: Optional[str] = None,
 ) -> jnp.ndarray:
     """GroupNorm over NHWC with affine params [C]; differentiable.
 
@@ -344,15 +359,26 @@ def group_norm(
     framework's global mesh is installed (an unwrapped pallas_call would
     be replicated by GSPMD there); ``False``/``True`` force the direct /
     partitioner-visible path.
+
+    ``activation="relu"`` fuses the ReLU epilogue into the kernel (the
+    separate XLA relu costs one extra HBM read+write of the whole
+    activation per call — material on the bandwidth-bound ResNet path);
+    the backward gates the cotangent by the recomputed pre-activation
+    sign, so gradients equal relu(group_norm(x)) exactly.
     """
     import os
 
+    if activation not in (None, "relu"):
+        raise ValueError(
+            f"activation must be None or 'relu', got {activation!r}"
+        )
+    relu = activation == "relu"
     if os.environ.get("CLOUD_TPU_GN_KERNEL", "") == "0":
         # Operational kill switch (the bench flips it when the hardware
         # gate fails, so a kernel regression degrades to the jnp path
         # instead of sinking the measurement).  Checked before every other
         # dispatch rule — including force-interpret — so it always wins.
-        return _reference(x, scale, bias, num_groups, eps)
+        return _reference(x, scale, bias, num_groups, eps, relu=relu)
     if not interpret and dispatch_lib.force_interpret():
         interpret = True
     if use_pallas is None:
@@ -362,7 +388,7 @@ def group_norm(
     if interpret and kernel_eligible(x, num_groups):
         use_pallas = True
     if not use_pallas or not kernel_eligible(x, num_groups):
-        return _reference(x, scale, bias, num_groups, eps)
+        return _reference(x, scale, bias, num_groups, eps, relu=relu)
     if partitioned is None:
         from cloud_tpu.parallel import mesh as mesh_lib
 
@@ -371,5 +397,5 @@ def group_norm(
     bias32 = bias.astype(jnp.float32)
     if partitioned:
         g = min(num_groups, x.shape[-1])
-        return _gn_partitioned(g, eps, interpret)(x, scale32, bias32)
-    return _gn(x, scale32, bias32, num_groups, eps, interpret)
+        return _gn_partitioned(g, eps, interpret, relu)(x, scale32, bias32)
+    return _gn(x, scale32, bias32, num_groups, eps, interpret, relu)

@@ -206,8 +206,8 @@ def _ab_fused_ce_main() -> int:
     """CloudLM fused-vs-plain cross-entropy A/B on the device.
 
     GPT-2-small-shaped config (12L x 768d, V=32k, tied head) at b4 x
-    T1024 bf16: the scale where the [B, T, V] logits tensor and its
-    log-softmax residual (~0.5 GiB together) start to matter.  Prints one
+    T1024 bf16: the scale where the [B, T, V] f32 logits tensor and its
+    log-softmax residual (~1 GiB together) start to matter.  Prints one
     JSON line per completed variant (partial-salvage contract).
     """
     import functools

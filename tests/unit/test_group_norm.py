@@ -160,3 +160,114 @@ class TestPartitioned:
             np.testing.assert_allclose(
                 np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-4
             )
+
+
+class TestFusedRelu:
+    """activation="relu": the kernel's in-VMEM epilogue must equal
+    relu(group_norm(x)) exactly, forward AND backward (the backward
+    gates the cotangent by the recomputed pre-activation sign), on the
+    direct, partitioned, and jnp-reference routes."""
+
+    def _args(self, shape=(3, 8, 8, 64), groups=32):
+        x = _rand(shape, seed=2)
+        c = shape[-1]
+        scale = _rand((c,), seed=3, scale=0.3, offset=1.0)
+        # Bias around zero so the relu gate cuts through the data.
+        bias = _rand((c,), seed=4, scale=0.5, offset=0.0)
+        return x, scale, bias, groups
+
+    def _loss(self, fn):
+        return lambda x, s, b: jnp.sum(fn(x, s, b) ** 2)
+
+    def test_kernel_matches_unfused_fwd_and_grad(self):
+        x, scale, bias, groups = self._args()
+
+        def fused(x, s, b):
+            return group_norm(x, s, b, num_groups=groups, use_pallas=True,
+                              interpret=True, partitioned=False,
+                              activation="relu")
+
+        def unfused(x, s, b):
+            return jnp.maximum(
+                _reference(x, s, b, groups), 0.0
+            )
+
+        got = jax.value_and_grad(self._loss(fused), argnums=(0, 1, 2))(
+            x, scale, bias
+        )
+        want = jax.value_and_grad(self._loss(unfused), argnums=(0, 1, 2))(
+            x, scale, bias
+        )
+        np.testing.assert_allclose(float(got[0]), float(want[0]), rtol=1e-5)
+        for g, w in zip(got[1], want[1]):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-4
+            )
+        # The gate is live: some outputs must actually be clamped.
+        assert float(jnp.mean(fused(x, scale, bias) == 0.0)) > 0.05
+
+    def test_reference_route_matches_too(self):
+        x, scale, bias, groups = self._args()
+        fused = group_norm(x, scale, bias, num_groups=groups,
+                           use_pallas=False, activation="relu")
+        np.testing.assert_allclose(
+            np.asarray(fused),
+            np.maximum(np.asarray(_reference(x, scale, bias, groups)), 0.0),
+            rtol=1e-6,
+        )
+
+    def test_partitioned_route_matches_direct(self):
+        x, scale, bias, groups = self._args(shape=(4, 8, 8, 64))
+        mesh = parallel.MeshSpec({"dp": 8}).build()
+
+        def fused(part):
+            def f(x, s, b):
+                return group_norm(
+                    x, s, b, num_groups=groups, use_pallas=True,
+                    interpret=True, partitioned=part, activation="relu",
+                )
+            return f
+
+        with parallel.use_mesh(mesh):
+            got = jax.jit(jax.value_and_grad(
+                self._loss(fused(True)), argnums=(0, 1, 2)
+            ))(x, scale, bias)
+        want = jax.value_and_grad(
+            self._loss(fused(False)), argnums=(0, 1, 2)
+        )(x, scale, bias)
+        np.testing.assert_allclose(float(got[0]), float(want[0]), rtol=1e-5)
+        for g, w in zip(got[1], want[1]):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-4
+            )
+
+    def test_resnet_trains_with_fused_activation(self):
+        """End to end: the model that uses the fusion still learns."""
+        import functools
+
+        import optax
+
+        from cloud_tpu.models import resnet
+        from cloud_tpu.training import train as train_lib
+
+        cfg = resnet.ResNetConfig(
+            stage_sizes=(1,), width=8, num_classes=4, num_groups=4
+        )
+        state = train_lib.create_sharded_state(
+            jax.random.PRNGKey(0),
+            functools.partial(resnet.init, config=cfg),
+            optax.sgd(0.05), mesh=None,
+        )
+        step = train_lib.make_train_step(
+            functools.partial(resnet.loss_fn, config=cfg), optax.sgd(0.05)
+        )
+        rng = np.random.default_rng(0)
+        batch = {
+            "image": rng.normal(size=(8, 16, 16, 3)).astype(np.float32),
+            "label": rng.integers(0, 4, 8),
+        }
+        losses = []
+        for _ in range(6):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
