@@ -420,21 +420,28 @@ def _check_group_norm(extras):
     s = jax.random.normal(k2, (128,), jnp.float32) * 0.2 + 1.0
     b = jnp.zeros((128,), jnp.float32)
 
-    def loss(x, s, b, use_pallas):
+    k3 = jax.random.split(k2)[0]
+    r = jax.random.normal(k3, x.shape, jnp.bfloat16)
+
+    def loss(x, s, b, r, use_pallas):
         y = group_norm(x, s, b, num_groups=32, use_pallas=use_pallas,
                        partitioned=False)
-        # The ResNet headline runs the fused-ReLU epilogue; gate it too.
+        # The ResNet headline runs the fused-ReLU epilogue AND the
+        # fused-residual bottleneck tail; gate both kernel variants.
         y2 = group_norm(x, s, b, num_groups=32, use_pallas=use_pallas,
                         partitioned=False, activation="relu")
+        y3 = group_norm(x, s, b, num_groups=32, use_pallas=use_pallas,
+                        partitioned=False, activation="relu", residual=r)
         return (
             jnp.sum(y.astype(jnp.float32) ** 2)
             + jnp.sum(y2.astype(jnp.float32) ** 2)
+            + jnp.sum(y3.astype(jnp.float32) ** 2)
         )
 
     got = jax.jit(jax.value_and_grad(lambda *a: loss(*a, True),
-                                     argnums=(0, 1, 2)))(x, s, b)
+                                     argnums=(0, 1, 2, 3)))(x, s, b, r)
     want = jax.jit(jax.value_and_grad(lambda *a: loss(*a, False),
-                                      argnums=(0, 1, 2)))(x, s, b)
+                                      argnums=(0, 1, 2, 3)))(x, s, b, r)
 
     def close(a, c):
         a = jnp.asarray(a, jnp.float32)

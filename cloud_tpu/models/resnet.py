@@ -67,7 +67,7 @@ def _gn_init(c):
     return {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
 
 
-def _gn(params, x, num_groups, activation=None):
+def _gn(params, x, num_groups, activation=None, residual=None):
     # Dispatches to the fused Pallas kernel on TPU (one HBM read for
     # stats+normalize+affine, custom VJP); the jnp fallback inside is the
     # one-pass shifted-moments implementation this model used previously
@@ -78,7 +78,7 @@ def _gn(params, x, num_groups, activation=None):
 
     return ops.group_norm(
         x, params["scale"], params["bias"], num_groups=num_groups,
-        activation=activation,
+        activation=activation, residual=residual,
     )
 
 
@@ -105,13 +105,15 @@ def _bottleneck(params, x, cfg, stride):
             activation="relu")
     y = _gn(params["gn2"], _conv(params["conv2"], y, stride=stride),
             cfg.num_groups, activation="relu")
-    y = _gn(params["gn3"], _conv(params["conv3"], y), cfg.num_groups)
     if "proj" in params:
         residual = _gn(
             params["gn_proj"], _conv(params["proj"], x, stride=stride),
             cfg.num_groups,
         )
-    return jax.nn.relu(residual + y)
+    # Tail fusion: relu(gn3(conv3) + residual) in one kernel pass — the
+    # separate add+relu re-read both [B,H,W,C] tensors from HBM.
+    return _gn(params["gn3"], _conv(params["conv3"], y), cfg.num_groups,
+               activation="relu", residual=residual)
 
 
 def init(rng, config: ResNetConfig = RESNET50) -> Dict[str, Any]:

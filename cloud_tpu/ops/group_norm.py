@@ -42,7 +42,8 @@ from cloud_tpu.ops import dispatch as dispatch_lib
 KERNEL_TRACE_COUNT = 0
 
 
-def _reference(x, scale, bias, num_groups, eps=1e-5, relu=False):
+def _reference(x, scale, bias, num_groups, eps=1e-5, relu=False,
+               residual=None):
     """Ground truth (and non-TPU fallback) — mirrors models/resnet.py."""
     b, h, w, c = x.shape
     g = min(num_groups, c)
@@ -54,6 +55,8 @@ def _reference(x, scale, bias, num_groups, eps=1e-5, relu=False):
     var = jnp.maximum(m2c - m1c * m1c, 0.0)
     y = (xc - m1c) * jax.lax.rsqrt(var + eps)
     y = y.reshape(b, h, w, c) * scale + bias
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
     if relu:
         y = jnp.maximum(y, 0.0)
     return y.astype(x.dtype)
@@ -70,15 +73,9 @@ def _onehot(c: int, g: int) -> jnp.ndarray:
     return (ch_group == group).astype(jnp.float32)
 
 
-def _fwd_kernel(x_ref, scale_ref, bias_ref, oh_ref, oht_ref, y_ref,
-                mean_ref, rstd_ref, *, eps, hw, cg, relu):
-    x = x_ref[0].astype(jnp.float32)
-    h, w, c = x.shape
-    x2 = x.reshape(hw, c)
-    oh = oh_ref[...]
-    oht = oht_ref[...]
+def _fwd_math(x2, scale_row, bias_row, oh, oht, hw, cg, eps):
+    """Shared forward math: [HW, C] -> (pre-activation y2, mean_g, rstd_g)."""
     n = float(hw * cg)
-
     pivot = x2[0:1, :]  # [1, C] per-channel shift
     xc = x2 - pivot
     s1 = jnp.sum(xc, axis=0, keepdims=True)        # [1, C]
@@ -92,8 +89,18 @@ def _fwd_kernel(x_ref, scale_ref, bias_ref, oh_ref, oht_ref, y_ref,
     var_g = (s2 - 2.0 * d * s1 + hw * d * d) @ oh / n
     rstd_g = jax.lax.rsqrt(jnp.maximum(var_g, 0.0) + eps)
     rstd_c = rstd_g @ oht                           # [1, C]
+    y2 = (x2 - mean_c) * rstd_c * scale_row + bias_row
+    return y2, mean_g, rstd_g
 
-    y = (x2 - mean_c) * rstd_c * scale_ref[...] + bias_ref[...]
+
+def _fwd_kernel(x_ref, scale_ref, bias_ref, oh_ref, oht_ref, y_ref,
+                mean_ref, rstd_ref, *, eps, hw, cg, relu):
+    x = x_ref[0].astype(jnp.float32)
+    h, w, c = x.shape
+    y, mean_g, rstd_g = _fwd_math(
+        x.reshape(hw, c), scale_ref[...], bias_ref[...],
+        oh_ref[...], oht_ref[...], hw, cg, eps,
+    )
     if relu:
         # Fused epilogue: the separate XLA relu would cost one more HBM
         # read+write of the whole activation on a bandwidth-bound model.
@@ -101,6 +108,39 @@ def _fwd_kernel(x_ref, scale_ref, bias_ref, oh_ref, oht_ref, y_ref,
     y_ref[0] = y.reshape(h, w, c).astype(y_ref.dtype)
     mean_ref[0] = mean_g[0]
     rstd_ref[0] = rstd_g[0]
+
+
+def _fwd_kernel_res(x_ref, scale_ref, bias_ref, res_ref, oh_ref, oht_ref,
+                    y_ref, mean_ref, rstd_ref, *, eps, hw, cg, relu):
+    """Forward with a fused residual add: y = [relu](gn(x) + residual) —
+    the bottleneck tail's add+relu never round-trips HBM separately."""
+    x = x_ref[0].astype(jnp.float32)
+    h, w, c = x.shape
+    y, mean_g, rstd_g = _fwd_math(
+        x.reshape(hw, c), scale_ref[...], bias_ref[...],
+        oh_ref[...], oht_ref[...], hw, cg, eps,
+    )
+    y = y + res_ref[0].astype(jnp.float32).reshape(hw, c)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    y_ref[0] = y.reshape(h, w, c).astype(y_ref.dtype)
+    mean_ref[0] = mean_g[0]
+    rstd_ref[0] = rstd_g[0]
+
+
+def _bwd_core(x2, dy2, mean_row, rstd_row, scale_row, oh, oht, n):
+    """GN backward for an already-gated cotangent: (dx2, ds, db)."""
+    mean_c = mean_row @ oht                         # [1, C]
+    rstd_c = rstd_row @ oht                         # [1, C]
+    xhat = (x2 - mean_c) * rstd_c
+    dxh = dy2 * scale_row
+
+    a_c = (jnp.sum(dxh, axis=0, keepdims=True) @ oh) @ oht         # [1, C]
+    b_c = (jnp.sum(dxh * xhat, axis=0, keepdims=True) @ oh) @ oht   # [1, C]
+    dx = rstd_c * (dxh - (a_c + xhat * b_c) / n)
+    ds = jnp.sum(dy2 * xhat, axis=0)                # [C] per-sample partial
+    db = jnp.sum(dy2, axis=0)                       # [C]
+    return dx, ds, db, xhat
 
 
 def _bwd_kernel(x_ref, dy_ref, mean_ref, rstd_ref, scale_ref, bias_ref,
@@ -114,22 +154,51 @@ def _bwd_kernel(x_ref, dy_ref, mean_ref, rstd_ref, scale_ref, bias_ref,
     oht = oht_ref[...]
     n = float(hw * cg)
 
-    mean_c = mean_ref[...] @ oht                    # [1, C]
-    rstd_c = rstd_ref[...] @ oht                    # [1, C]
-    xhat = (x2 - mean_c) * rstd_c
     if relu:
         # Recompute the pre-activation sign from the saved stats: the
         # relu gate zeroes the cotangent where the fused forward clamped.
-        pre = xhat * scale_ref[...] + bias_ref[...]
+        mean_c = mean_ref[...] @ oht
+        rstd_c = rstd_ref[...] @ oht
+        pre = (x2 - mean_c) * rstd_c * scale_ref[...] + bias_ref[...]
         dy2 = jnp.where(pre > 0.0, dy2, 0.0)
-    dxh = dy2 * scale_ref[...]
-
-    a_c = (jnp.sum(dxh, axis=0, keepdims=True) @ oh) @ oht         # [1, C]
-    b_c = (jnp.sum(dxh * xhat, axis=0, keepdims=True) @ oh) @ oht   # [1, C]
-    dx = rstd_c * (dxh - (a_c + xhat * b_c) / n)
+    dx, ds, db, _ = _bwd_core(
+        x2, dy2, mean_ref[...], rstd_ref[...], scale_ref[...], oh, oht, n
+    )
     dx_ref[0] = dx.reshape(h, w, c).astype(dx_ref.dtype)
-    ds_ref[0] = jnp.sum(dy2 * xhat, axis=0)         # [C] per-sample partial
-    db_ref[0] = jnp.sum(dy2, axis=0)                # [C]
+    ds_ref[0] = ds
+    db_ref[0] = db
+
+
+def _bwd_kernel_res(x_ref, dy_ref, mean_ref, rstd_ref, scale_ref, bias_ref,
+                    res_ref, oh_ref, oht_ref, dx_ref, ds_ref, db_ref,
+                    dres_ref, *, hw, cg, relu):
+    """Backward of y = [relu](gn(x) + residual): the gate (recomputed
+    from stats + the residual) applies to BOTH branches; the residual's
+    cotangent is exactly the gated dy."""
+    x = x_ref[0].astype(jnp.float32)
+    dy = dy_ref[0].astype(jnp.float32)
+    h, w, c = x.shape
+    x2 = x.reshape(hw, c)
+    dy2 = dy.reshape(hw, c)
+    oh = oh_ref[...]
+    oht = oht_ref[...]
+    n = float(hw * cg)
+
+    if relu:
+        mean_c = mean_ref[...] @ oht
+        rstd_c = rstd_ref[...] @ oht
+        pre = (
+            (x2 - mean_c) * rstd_c * scale_ref[...] + bias_ref[...]
+            + res_ref[0].astype(jnp.float32).reshape(hw, c)
+        )
+        dy2 = jnp.where(pre > 0.0, dy2, 0.0)
+    dres_ref[0] = dy2.reshape(h, w, c).astype(dres_ref.dtype)
+    dx, ds, db, _ = _bwd_core(
+        x2, dy2, mean_ref[...], rstd_ref[...], scale_ref[...], oh, oht, n
+    )
+    dx_ref[0] = dx.reshape(h, w, c).astype(dx_ref.dtype)
+    ds_ref[0] = ds
+    db_ref[0] = db
 
 
 def _block_specs(b, h, w, c, g):
@@ -190,6 +259,58 @@ def _bwd_pallas(x, dy, mean, rstd, scale, bias, num_groups, interpret,
     return dx, ds, db
 
 
+def _fwd_pallas_res(x, scale, bias, residual, num_groups, eps, interpret,
+                    relu):
+    global KERNEL_TRACE_COUNT
+    KERNEL_TRACE_COUNT += 1
+    b, h, w, c = x.shape
+    g = min(num_groups, c)
+    hw, cg = h * w, c // g
+    oh = _onehot(c, g)
+    x_spec, vec_spec, oh_spec, oht_spec, stat_spec = _block_specs(b, h, w, c, g)
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel_res, eps=eps, hw=hw, cg=cg, relu=relu),
+        grid=(b,),
+        in_specs=[x_spec, vec_spec, vec_spec, x_spec, oh_spec, oht_spec],
+        out_specs=[x_spec, stat_spec, stat_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct((b, g), jnp.float32),
+            jax.ShapeDtypeStruct((b, g), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, scale.reshape(1, c), bias.reshape(1, c), residual, oh, oh.T)
+    return y, mean, rstd
+
+
+def _bwd_pallas_res(x, dy, mean, rstd, scale, bias, residual, num_groups,
+                    interpret, relu):
+    global KERNEL_TRACE_COUNT
+    KERNEL_TRACE_COUNT += 1
+    b, h, w, c = x.shape
+    g = min(num_groups, c)
+    hw, cg = h * w, c // g
+    oh = _onehot(c, g)
+    x_spec, vec_spec, oh_spec, oht_spec, stat_spec = _block_specs(b, h, w, c, g)
+    partial_spec = pl.BlockSpec((1, c), lambda i: (i, 0))
+    dx, ds, db, dres = pl.pallas_call(
+        functools.partial(_bwd_kernel_res, hw=hw, cg=cg, relu=relu),
+        grid=(b,),
+        in_specs=[x_spec, x_spec, stat_spec, stat_spec, vec_spec, vec_spec,
+                  x_spec, oh_spec, oht_spec],
+        out_specs=[x_spec, partial_spec, partial_spec, x_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct((b, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, c), jnp.float32),
+            jax.ShapeDtypeStruct(residual.shape, residual.dtype),
+        ],
+        interpret=interpret,
+    )(x, dy, mean, rstd, scale.reshape(1, c), bias.reshape(1, c), residual,
+      oh, oh.T)
+    return dx, ds, db, dres
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _gn(x, scale, bias, num_groups, eps, interpret, relu=False):
     y, _, _ = _fwd_pallas(x, scale, bias, num_groups, eps, interpret,
@@ -212,6 +333,42 @@ def _gn_bwd(num_groups, eps, interpret, relu, residuals, dy):
 
 
 _gn.defvjp(_gn_fwd, _gn_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _gn_res(x, scale, bias, residual, num_groups, eps, interpret, relu):
+    y, _, _ = _fwd_pallas_res(x, scale, bias, residual, num_groups, eps,
+                              interpret, relu)
+    return y
+
+
+def _gn_res_fwd(x, scale, bias, residual, num_groups, eps, interpret, relu):
+    y, mean, rstd = _fwd_pallas_res(x, scale, bias, residual, num_groups,
+                                    eps, interpret, relu)
+    # Without relu the backward never reads the residual (dres == dy
+    # exactly); keep only a zero-size dtype token so the full tensor
+    # neither lives in residuals nor streams through the bwd kernel.
+    saved_res = residual if relu else residual[:0]
+    return y, (x, mean, rstd, scale, bias, saved_res)
+
+
+def _gn_res_bwd(num_groups, eps, interpret, relu, residuals, dy):
+    x, mean, rstd, scale, bias, saved_res = residuals
+    if relu:
+        dx, ds, db, dres = _bwd_pallas_res(
+            x, dy, mean, rstd, scale, bias, saved_res, num_groups,
+            interpret, relu,
+        )
+    else:
+        dx, ds, db = _bwd_pallas(
+            x, dy, mean, rstd, scale, bias, num_groups, interpret,
+            relu=False,
+        )
+        dres = dy.astype(saved_res.dtype)
+    return dx, jnp.sum(ds, axis=0), jnp.sum(db, axis=0), dres
+
+
+_gn_res.defvjp(_gn_res_fwd, _gn_res_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -297,6 +454,106 @@ def _cp_bwd_call(num_groups, interpret, relu=False):
 
 
 @functools.lru_cache(maxsize=None)
+def _cp_fwd_call_res(num_groups, eps, interpret, relu):
+    from jax.experimental.custom_partitioning import (
+        SdyShardingRule,
+        custom_partitioning,
+    )
+
+    def impl(x, scale, bias, residual):
+        y, mean, rstd = _fwd_pallas_res(x, scale, bias, residual,
+                                        num_groups, eps, interpret, relu)
+        return y, mean[..., None, None], rstd[..., None, None]
+
+    fn = custom_partitioning(impl)
+    infer, part = dispatch_lib.passthrough_callbacks(impl, 3)
+    bhwc = ("b", "h", "w", "c")
+    fn.def_partition(
+        infer_sharding_from_operands=infer,
+        partition=part,
+        sharding_rule=SdyShardingRule(
+            operand_mappings=(bhwc, ("c",), ("c",), bhwc),
+            result_mappings=(bhwc, ("b", "g", "o1", "o2"),
+                             ("b", "g2", "o3", "o4")),
+            need_replication_factors=(
+                "h", "w", "c", "g", "o1", "o2", "g2", "o3", "o4"
+            ),
+        ),
+    )
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _cp_bwd_call_res(num_groups, interpret, relu):
+    from jax.experimental.custom_partitioning import (
+        SdyShardingRule,
+        custom_partitioning,
+    )
+
+    def impl(x, dy, mean4, rstd4, scale, bias, residual):
+        dx, ds, db, dres = _bwd_pallas_res(
+            x, dy, mean4[..., 0, 0], rstd4[..., 0, 0], scale, bias,
+            residual, num_groups, interpret, relu,
+        )
+        return dx, ds[:, None, None, :], db[:, None, None, :], dres
+
+    fn = custom_partitioning(impl)
+    infer, part = dispatch_lib.passthrough_callbacks(impl, 4)
+    bhwc = ("b", "h", "w", "c")
+    fn.def_partition(
+        infer_sharding_from_operands=infer,
+        partition=part,
+        sharding_rule=SdyShardingRule(
+            operand_mappings=(bhwc, bhwc, ("b", "g", "o1", "o2"),
+                              ("b", "g2", "o3", "o4"), ("c",), ("c",),
+                              bhwc),
+            result_mappings=(bhwc, ("b", "o5", "o6", "c"),
+                             ("b", "o7", "o8", "c"), bhwc),
+            need_replication_factors=(
+                "h", "w", "c", "g", "o1", "o2", "g2", "o3", "o4",
+                "o5", "o6", "o7", "o8",
+            ),
+        ),
+    )
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _gn_partitioned_res(num_groups, eps, interpret, relu):
+    fwd_call = _cp_fwd_call_res(num_groups, eps, interpret, relu)
+    bwd_call = _cp_bwd_call_res(num_groups, interpret, relu)
+
+    plain_bwd_call = _cp_bwd_call(num_groups, interpret, relu=False)
+
+    @jax.custom_vjp
+    def f(x, scale, bias, residual):
+        y, _, _ = fwd_call(x, scale, bias, residual)
+        return y
+
+    def f_fwd(x, scale, bias, residual):
+        y, mean4, rstd4 = fwd_call(x, scale, bias, residual)
+        saved_res = residual if relu else residual[:0]
+        return y, (x, mean4, rstd4, scale, bias, saved_res)
+
+    def f_bwd(res, dy):
+        x, mean4, rstd4, scale, bias, saved_res = res
+        if relu:
+            dx, ds4, db4, dres = bwd_call(
+                x, dy, mean4, rstd4, scale, bias, saved_res
+            )
+        else:
+            dx, ds4, db4 = plain_bwd_call(
+                x, dy, mean4, rstd4, scale, bias
+            )
+            dres = dy.astype(saved_res.dtype)
+        return (dx, jnp.sum(ds4, axis=(0, 1, 2)),
+                jnp.sum(db4, axis=(0, 1, 2)), dres)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
 def _gn_partitioned(num_groups, eps, interpret, relu=False):
     fwd_call = _cp_fwd_call(num_groups, eps, interpret, relu)
     bwd_call = _cp_bwd_call(num_groups, interpret, relu)
@@ -321,10 +578,11 @@ def _gn_partitioned(num_groups, eps, interpret, relu=False):
     return f
 
 
-def kernel_eligible(x, num_groups) -> bool:
+def kernel_eligible(x, num_groups, has_residual: bool = False) -> bool:
     """Shapes the kernel handles: 4-D NHWC, groups divide channels, the
     [HW, C] view sublane-aligned, and a per-sample block that fits VMEM
-    (f32 activation + working copies, conservatively 4 MiB)."""
+    (f32 activation + working copies, conservatively 4 MiB; halved when
+    a fused residual doubles the resident blocks)."""
     if x.ndim != 4:
         return False
     b, h, w, c = x.shape
@@ -333,7 +591,8 @@ def kernel_eligible(x, num_groups) -> bool:
         return False
     if (h * w) % 8:
         return False
-    return h * w * c * 4 <= 4 * 1024 * 1024
+    budget = (2 if has_residual else 4) * 1024 * 1024
+    return h * w * c * 4 <= budget
 
 
 def group_norm(
@@ -347,6 +606,7 @@ def group_norm(
     interpret: bool = False,
     partitioned: Optional[bool] = None,
     activation: Optional[str] = None,
+    residual: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """GroupNorm over NHWC with affine params [C]; differentiable.
 
@@ -365,6 +625,11 @@ def group_norm(
     activation per call — material on the bandwidth-bound ResNet path);
     the backward gates the cotangent by the recomputed pre-activation
     sign, so gradients equal relu(group_norm(x)) exactly.
+
+    ``residual`` (same shape as x) fuses a residual add BEFORE the
+    activation — ``[relu](group_norm(x) + residual)`` — the ResNet
+    bottleneck tail, whose separate add+relu otherwise re-reads both
+    tensors from HBM.  Fully differentiable in the residual too.
     """
     import os
 
@@ -373,29 +638,56 @@ def group_norm(
             f"activation must be None or 'relu', got {activation!r}"
         )
     relu = activation == "relu"
+    if residual is not None and residual.shape != x.shape:
+        raise ValueError(
+            f"residual shape {residual.shape} != x shape {x.shape}"
+        )
     if os.environ.get("CLOUD_TPU_GN_KERNEL", "") == "0":
         # Operational kill switch (the bench flips it when the hardware
         # gate fails, so a kernel regression degrades to the jnp path
         # instead of sinking the measurement).  Checked before every other
         # dispatch rule — including force-interpret — so it always wins.
-        return _reference(x, scale, bias, num_groups, eps, relu=relu)
+        return _reference(x, scale, bias, num_groups, eps, relu=relu,
+                          residual=residual)
     if not interpret and dispatch_lib.force_interpret():
         interpret = True
+    has_res = residual is not None
     if use_pallas is None:
         use_pallas = (
-            jax.default_backend() == "tpu" and kernel_eligible(x, num_groups)
+            jax.default_backend() == "tpu"
+            and kernel_eligible(x, num_groups)
         )
     if interpret and kernel_eligible(x, num_groups):
         use_pallas = True
     if not use_pallas or not kernel_eligible(x, num_groups):
-        return _reference(x, scale, bias, num_groups, eps, relu=relu)
+        return _reference(x, scale, bias, num_groups, eps, relu=relu,
+                          residual=residual)
+    if has_res and not kernel_eligible(x, num_groups, True):
+        # The block + residual pair exceeds the VMEM budget: drop ONLY
+        # the fusion (kernel GN + XLA add/relu — the pre-fusion
+        # schedule), never the whole kernel.
+        y = group_norm(
+            x, scale, bias, num_groups=num_groups, eps=eps,
+            use_pallas=True, interpret=interpret, partitioned=partitioned,
+        )
+        y = y.astype(jnp.float32) + residual.astype(jnp.float32)
+        if relu:
+            y = jnp.maximum(y, 0.0)
+        return y.astype(x.dtype)
     if partitioned is None:
         from cloud_tpu.parallel import mesh as mesh_lib
 
         partitioned = mesh_lib.get_global_mesh() is not None
     scale32 = scale.astype(jnp.float32)
     bias32 = bias.astype(jnp.float32)
+    g = min(num_groups, x.shape[-1])
+    if residual is not None:
+        if partitioned:
+            return _gn_partitioned_res(g, eps, interpret, relu)(
+                x, scale32, bias32, residual
+            )
+        return _gn_res(x, scale32, bias32, residual, num_groups, eps,
+                       interpret, relu)
     if partitioned:
-        g = min(num_groups, x.shape[-1])
         return _gn_partitioned(g, eps, interpret, relu)(x, scale32, bias32)
     return _gn(x, scale32, bias32, num_groups, eps, interpret, relu)

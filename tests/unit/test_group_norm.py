@@ -271,3 +271,167 @@ class TestFusedRelu:
             state, metrics = step(state, batch)
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0], losses
+
+
+class TestFusedResidual:
+    """residual=: [relu](gn(x) + r) in one kernel — must equal the
+    unfused composition exactly, with gradients flowing to x, scale,
+    bias, AND the residual, on every route."""
+
+    def _args(self, shape=(3, 8, 8, 64), groups=32):
+        x = _rand(shape, seed=5)
+        r = _rand(shape, seed=6, scale=1.0, offset=0.0)
+        c = shape[-1]
+        scale = _rand((c,), seed=7, scale=0.3, offset=1.0)
+        bias = _rand((c,), seed=8, scale=0.5, offset=0.0)
+        return x, scale, bias, r, groups
+
+    def _unfused(self, groups, relu):
+        def f(x, s, b, r):
+            y = _reference(x, s, b, groups) + r
+            return jnp.maximum(y, 0.0) if relu else y
+        return f
+
+    @pytest.mark.parametrize("relu", [True, False])
+    def test_kernel_matches_unfused(self, relu):
+        x, scale, bias, r, groups = self._args()
+
+        def fused(x, s, b, r):
+            return group_norm(
+                x, s, b, num_groups=groups, use_pallas=True, interpret=True,
+                partitioned=False, residual=r,
+                activation="relu" if relu else None,
+            )
+
+        loss = lambda fn: (
+            lambda x, s, b, r: jnp.sum(fn(x, s, b, r) ** 2)
+        )
+        got = jax.value_and_grad(loss(fused), argnums=(0, 1, 2, 3))(
+            x, scale, bias, r
+        )
+        want = jax.value_and_grad(
+            loss(self._unfused(groups, relu)), argnums=(0, 1, 2, 3)
+        )(x, scale, bias, r)
+        np.testing.assert_allclose(float(got[0]), float(want[0]), rtol=1e-5)
+        for g, w in zip(got[1], want[1]):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-4
+            )
+
+    def test_partitioned_route_matches_direct(self):
+        x, scale, bias, r, groups = self._args(shape=(4, 8, 8, 64))
+        mesh = parallel.MeshSpec({"dp": 8}).build()
+
+        def fused(part):
+            def f(x, s, b, r):
+                return group_norm(
+                    x, s, b, num_groups=groups, use_pallas=True,
+                    interpret=True, partitioned=part, residual=r,
+                    activation="relu",
+                )
+            return f
+
+        loss = lambda fn: (
+            lambda x, s, b, r: jnp.sum(fn(x, s, b, r) ** 2)
+        )
+        with parallel.use_mesh(mesh):
+            got = jax.jit(jax.value_and_grad(
+                loss(fused(True)), argnums=(0, 1, 2, 3)
+            ))(x, scale, bias, r)
+        want = jax.value_and_grad(
+            loss(fused(False)), argnums=(0, 1, 2, 3)
+        )(x, scale, bias, r)
+        np.testing.assert_allclose(float(got[0]), float(want[0]), rtol=1e-5)
+        for g, w in zip(got[1], want[1]):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-4
+            )
+
+    def test_shape_mismatch_rejected(self):
+        x, scale, bias, r, groups = self._args()
+        with pytest.raises(ValueError, match="residual shape"):
+            group_norm(x, scale, bias, num_groups=groups,
+                       residual=r[:, :4])
+
+    def test_reference_route_residual(self):
+        x, scale, bias, r, groups = self._args()
+        got = group_norm(x, scale, bias, num_groups=groups,
+                         use_pallas=False, residual=r, activation="relu")
+        want = np.maximum(
+            np.asarray(_reference(x, scale, bias, groups)) + np.asarray(r),
+            0.0,
+        )
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    def test_large_block_drops_fusion_not_kernel(self, monkeypatch):
+        """ResNet-224 stage-0 tails (56x56x256) exceed the residual VMEM
+        budget: the dispatch must fall back to kernel-GN + XLA add/relu
+        (the pre-fusion schedule), NOT to the jnp reference."""
+        import sys
+
+        # NB: ``import cloud_tpu.ops.group_norm`` yields the FUNCTION
+        # (ops/__init__ rebinds the package attribute); the module lives
+        # in sys.modules.
+        gn_mod = sys.modules["cloud_tpu.ops.group_norm"]
+
+        def boom(*a, **k):
+            raise AssertionError("residual kernel ran on oversized block")
+
+        monkeypatch.setattr(gn_mod, "_fwd_pallas_res", boom)
+        calls = {"n": 0}
+        real = gn_mod._fwd_pallas
+
+        def spy(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(gn_mod, "_fwd_pallas", spy)
+        shape, groups = (1, 56, 56, 256), 32
+        x = _rand(shape, seed=9)
+        r = _rand(shape, seed=10, scale=1.0, offset=0.0)
+        scale = _rand((256,), seed=11, scale=0.3, offset=1.0)
+        bias = _rand((256,), seed=12, scale=0.5, offset=0.0)
+        got = group_norm(x, scale, bias, num_groups=groups, use_pallas=True,
+                         interpret=True, partitioned=False, residual=r,
+                         activation="relu")
+        assert calls["n"] == 1  # the plain KERNEL ran (not the reference)
+        want = np.maximum(
+            np.asarray(_reference(x, scale, bias, groups)) + np.asarray(r),
+            0.0,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), want, rtol=1e-4, atol=1e-4
+        )
+
+    def test_no_relu_backward_skips_residual_kernel(self, monkeypatch):
+        """With activation=None, dres == dy exactly: the backward must
+        not stream the residual through the fused bwd kernel at all."""
+        import sys
+
+        gn_mod = sys.modules["cloud_tpu.ops.group_norm"]
+
+        def boom(*a, **k):
+            raise AssertionError("residual bwd kernel ran with relu=False")
+
+        monkeypatch.setattr(gn_mod, "_bwd_pallas_res", boom)
+        x, scale, bias, r, groups = self._args()
+
+        def f(x, s, b, r):
+            return jnp.sum(group_norm(
+                x, s, b, num_groups=groups, use_pallas=True, interpret=True,
+                partitioned=False, residual=r,
+            ) ** 2)
+
+        _, grads = jax.value_and_grad(f, argnums=(0, 1, 2, 3))(
+            x, scale, bias, r
+        )
+        want = jax.value_and_grad(
+            lambda x, s, b, r: jnp.sum(
+                (_reference(x, s, b, groups) + r) ** 2
+            ),
+            argnums=(0, 1, 2, 3),
+        )(x, scale, bias, r)[1]
+        for g, w in zip(grads, want):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-4
+            )
