@@ -457,6 +457,47 @@ def _check_group_norm(extras):
     extras["group_norm_kernel_ok"] = True
 
 
+def _measure_decode(extras):
+    """Generation decode throughput: CloudLM SMALL (124M, GPT-2 shape),
+    KV-cache greedy decode, tokens/sec — the capability's perf number
+    (BASELINE.md had none).  Chain-then-read applies: the sequences
+    output depends on every decode step, so one host read pays for the
+    whole chained run."""
+    import functools
+    import time as time_mod
+
+    import jax
+    import numpy as np
+
+    from cloud_tpu.models import generation, transformer
+
+    cfg = transformer.SMALL
+    b, t_prompt, new = 4, 128, 128
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    params = jax.device_put(params)
+    rng = np.random.default_rng(0)
+    prompts = jax.device_put(
+        rng.integers(1, cfg.vocab_size, (b, t_prompt)).astype(np.int32)
+    )
+    lens = jax.device_put(np.full((b,), t_prompt, np.int32))
+
+    run = jax.jit(functools.partial(
+        generation.generate, config=cfg, max_new_tokens=new, mesh=None,
+    ))
+    out = run(params, prompts, lens)
+    float(out["sequences"].astype(np.float32).sum())  # warmup + compile
+    iters = 4
+    start = time_mod.perf_counter()
+    acc = 0.0
+    for _ in range(iters):
+        out = run(params, prompts, lens)
+        acc += float(out["sequences"].astype(np.float32).sum())
+    elapsed = time_mod.perf_counter() - start
+    tokens_per_sec = iters * b * new / elapsed
+    extras["decode_tokens_per_sec"] = round(tokens_per_sec, 1)
+    extras["decode_config"] = f"SMALL b{b} prompt{t_prompt} new{new}"
+
+
 def _child_main() -> int:
     """Headline first; every phase prints its own salvageable JSON line."""
     extras = {}
@@ -499,6 +540,7 @@ def _child_main() -> int:
         (_check_flash_attention, "flash_attention"),
         (_measure_bert, "bert"),
         (_measure_resnet224, "resnet224"),
+        (_measure_decode, "decode"),
     ):
         phase_extras = {"peak_bf16_tflops": extras.get("peak_bf16_tflops")}
         try:
