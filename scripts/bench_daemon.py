@@ -266,6 +266,43 @@ def _ab_fused_ce_main() -> int:
     return 0
 
 
+def _ab_gn_main() -> int:
+    """ResNet50-CIFAR b256: GroupNorm kernel + fusions vs pure XLA.
+
+    The headline's framework win in one A/B — 'on' is the default path
+    (fused GN kernel incl. relu/residual epilogues), 'off' flips
+    CLOUD_TPU_GN_KERNEL=0 so every call takes the jnp/XLA path.  The env
+    is read at trace time, so two separately-built steps in one process
+    measure both paths.  Prints one JSON line per completed variant.
+    """
+    import jax
+
+    sys.path.insert(0, REPO)
+    from cloud_tpu.utils.benchmarking import (
+        chain_then_read_throughput,
+        resnet_train_setup,
+    )
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"phase": "resnet_gn_ab", "ok": False,
+                          "error": "backend is not tpu"}), flush=True)
+        return 1
+
+    out = {"phase": "resnet_gn_ab", "ok": True, "ab": {}}
+    for name, env_val in (("kernel_fused", "1"), ("xla", "0")):
+        os.environ["CLOUD_TPU_GN_KERNEL"] = env_val
+        step, state, batch = resnet_train_setup(
+            imagenet_shape=False, batch_size=256
+        )
+        compiled = step.lower(state, batch).compile()
+        steps_per_sec = chain_then_read_throughput(
+            compiled, state, batch, warmup=3, iters=15
+        )
+        out["ab"][name] = {"steps_per_sec": round(steps_per_sec, 2)}
+        print(json.dumps(out), flush=True)
+    return 0
+
+
 # --------------------------------------------------------------------------
 # Daemon loop.
 
@@ -331,6 +368,7 @@ def _cycle(bench, state) -> bool:
     for flag, phase in (
         ("--ab", "bert_opt_ab"),
         ("--ab-fused-ce", "lm_fused_ce_ab"),
+        ("--ab-gn", "resnet_gn_ab"),
     ):
         try:
             proc = bench._hardened_run(
@@ -382,6 +420,8 @@ def main() -> int:
 if __name__ == "__main__":
     if "--ab-fused-ce" in sys.argv:
         sys.exit(_ab_fused_ce_main())
+    if "--ab-gn" in sys.argv:
+        sys.exit(_ab_gn_main())
     if "--ab" in sys.argv:
         sys.exit(_ab_main())
     sys.exit(main())
