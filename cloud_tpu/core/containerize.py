@@ -67,10 +67,20 @@ def default_jax_pin() -> Optional[str]:
     Returns None (=> install unpinned, with a warning) when the local jax
     is a dev/source build whose version has no PyPI release to pin to —
     the reference's nightly fallback (:160-185) for the same situation.
-    """
-    import jax
 
-    version = jax.__version__
+    Reads the installed distribution's metadata instead of importing jax:
+    a cold ``import jax`` costs ~1.5-2 s, which would triple run()'s
+    submit-artifacts latency (the north-star half BASELINE.md tracks)
+    just to learn a version string.
+    """
+    try:
+        import importlib.metadata
+
+        version = importlib.metadata.version("jax")
+    except Exception:  # noqa: BLE001 — source trees without dist-info
+        import jax
+
+        version = jax.__version__
     if "dev" in version or "+" in version:
         logger.warning(
             "local jax %s is a dev/source build with no released wheel; "
