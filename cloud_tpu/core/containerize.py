@@ -68,19 +68,26 @@ def default_jax_pin() -> Optional[str]:
     is a dev/source build whose version has no PyPI release to pin to —
     the reference's nightly fallback (:160-185) for the same situation.
 
-    Reads the installed distribution's metadata instead of importing jax:
-    a cold ``import jax`` costs ~1.5-2 s, which would triple run()'s
-    submit-artifacts latency (the north-star half BASELINE.md tracks)
-    just to learn a version string.
+    When jax is already imported, its ``__version__`` is the truth (an
+    editable/source checkout shadowing an installed wheel must not be
+    pinned to the stale dist-info).  Otherwise read the distribution
+    metadata rather than importing: a cold ``import jax`` costs ~1.5-2 s,
+    which would triple run()'s submit-artifacts latency (the north-star
+    half BASELINE.md tracks) just to learn a version string.
     """
-    try:
-        import importlib.metadata
+    import sys
 
-        version = importlib.metadata.version("jax")
-    except Exception:  # noqa: BLE001 — source trees without dist-info
-        import jax
+    if "jax" in sys.modules:
+        version = sys.modules["jax"].__version__
+    else:
+        try:
+            import importlib.metadata
 
-        version = jax.__version__
+            version = importlib.metadata.version("jax")
+        except Exception:  # noqa: BLE001 — source trees without dist-info
+            import jax
+
+            version = jax.__version__
     if "dev" in version or "+" in version:
         logger.warning(
             "local jax %s is a dev/source build with no released wheel; "

@@ -120,7 +120,9 @@ def encode(
                                rules=rules)
     # Positions are always arange: a static slice of the table broadcast
     # over batch — no gather, nothing for SPMD to rematerialize.
-    x = x + params["pos"]["table"][:t].astype(cfg.dtype)[None, :, :]
+    x = x + layers.materialize_matrix(
+        params["pos"], "table", cfg.dtype
+    )[:t][None, :, :]
     if segment_ids is not None:
         x = x + layers.embedding_apply(params["seg"], segment_ids,
                                        dtype=cfg.dtype, rules=rules)

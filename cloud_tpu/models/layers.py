@@ -80,9 +80,25 @@ def dense_init(rng, in_dim: int, out_dim: int, *, in_axis: Optional[str],
     return params, dense_axes(in_axis, out_axis, use_bias)
 
 
+def materialize_matrix(params, name: str, dtype):
+    """The (possibly int8-quantized) matrix ``name`` at compute width.
+
+    Weight-only quantization stores ``{name}_q`` (int8) +
+    ``{name}_scale`` (models/quantization.py); the dequant multiply is
+    fused by XLA into the consuming matmul/gather, so only the narrow
+    tensor crosses HBM.
+    """
+    if f"{name}_q" in params:
+        return (
+            params[f"{name}_q"].astype(dtype)
+            * params[f"{name}_scale"].astype(dtype)
+        )
+    return params[name].astype(dtype)
+
+
 def dense_apply(params, x, *, dtype=None):
     dtype = dtype or x.dtype
-    y = jnp.einsum("...i,io->...o", x, params["kernel"].astype(dtype))
+    y = jnp.einsum("...i,io->...o", x, materialize_matrix(params, "kernel", dtype))
     if "bias" in params:
         y = y + params["bias"].astype(dtype)
     return y
@@ -106,9 +122,18 @@ def embedding_apply(params, token_ids, *, dtype=jnp.float32,
     the gather partitions by its index dims instead.  ``mesh`` falls back
     to the global mesh, like every shard_constraint.
     """
-    table = params["table"].astype(dtype)
-    table = shard_constraint(table, None, None, rules=rules, mesh=mesh)
-    out = jnp.take(table, token_ids, axis=0)
+    if "table_q" in params:
+        # Weight-only int8: gather narrow rows, then scale the gathered
+        # rows (per-row scales) — the full-width table never materializes.
+        rows = jnp.take(params["table_q"], token_ids, axis=0).astype(dtype)
+        scales = jnp.take(
+            params["table_scale"].astype(dtype), token_ids, axis=0
+        )
+        out = rows * scales
+    else:
+        table = params["table"].astype(dtype)
+        table = shard_constraint(table, None, None, rules=rules, mesh=mesh)
+        out = jnp.take(table, token_ids, axis=0)
     if token_ids.ndim == 2:
         out = shard_constraint(out, "batch", "seq", "act_embed", rules=rules,
                                mesh=mesh)

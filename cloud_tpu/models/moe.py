@@ -106,10 +106,15 @@ def moe_mlp_apply(
     dispatch = (combine > 0.0).astype(x.dtype)  # [B, T, E, C]
 
     expert_in = jnp.einsum("btec,btd->becd", dispatch, x)
+    # materialize_matrix: quantization-aware (wi/wg/wo may be stored
+    # int8 + per-(expert, out) scales — models/quantization.py).
+    wi = layers.materialize_matrix(params, "wi", x.dtype)
+    wg = layers.materialize_matrix(params, "wg", x.dtype)
+    wo = layers.materialize_matrix(params, "wo", x.dtype)
     h = jax.nn.silu(
-        jnp.einsum("becd,edh->bech", expert_in, params["wi"].astype(x.dtype))
-    ) * jnp.einsum("becd,edh->bech", expert_in, params["wg"].astype(x.dtype))
-    expert_out = jnp.einsum("bech,ehd->becd", h, params["wo"].astype(x.dtype))
+        jnp.einsum("becd,edh->bech", expert_in, wi)
+    ) * jnp.einsum("becd,edh->bech", expert_in, wg)
+    expert_out = jnp.einsum("bech,ehd->becd", h, wo)
     out = jnp.einsum("btec,becd->btd", combine.astype(x.dtype), expert_out)
 
     # Load-balance loss: encourages uniform routing (Switch/GShard form).

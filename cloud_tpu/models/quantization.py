@@ -1,0 +1,130 @@
+"""Weight-only int8 quantization for the inference path.
+
+Why: KV-cache decode is HBM-bound — each generated token re-reads every
+parameter once, so at 124M+ params the weight stream IS the decode cost.
+Storing matrices as int8 with per-output-channel f32 scales halves the
+bytes per step (vs bf16; 4x vs f32); the matmuls still run at full
+width — XLA fuses the ``q.astype(dtype) * scale`` dequant into the
+consumer, so the narrow tensor is what crosses HBM.
+
+Scheme (symmetric, per-channel):
+
+* matmul weights — ``kernel`` (2-D dense / [L, in, out] stacked scan
+  layers; 4-D CONV kernels are skipped — their consumer reads the raw
+  leaf) and the MoE expert matrices ``wi``/``wg``/``wo``
+  ([E, in, out]): scale over ``axis=-2`` — one scale per (..., out)
+  channel, shape ``[..., 1, out]``.
+* embedding tables ``[V, D]``: scale over ``axis=-1`` (per row/token,
+  shape ``[V, 1]``) — correct for BOTH uses of the table: the lookup
+  (gather rows, scale rows) and the tied LM head (x @ table^T: rows are
+  the vocab output channels).  Positional tables read by slice (BERT/
+  ViT ``pos``) go through ``layers.materialize_matrix`` at apply time.
+
+Inference-only: quantized trees feed ``generation.generate`` /
+``transformer.apply``; the training stack expects full-precision params
+(gradients through a dequant make no sense for int8 storage).  The
+reference framework has no inference path at all (its serving story was
+"save a SavedModel") — this is TPU-native capability on top of parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: Leaves smaller than this stay full precision: norm scales, biases and
+#: tiny kernels contribute nothing to the weight stream but would lose
+#: accuracy.
+MIN_QUANT_ELEMENTS = 16384
+
+
+def quantize_array(w: jnp.ndarray, *, axis: int) -> Tuple[jnp.ndarray,
+                                                          jnp.ndarray]:
+    """Symmetric int8 with per-channel scales over ``axis`` (keepdims).
+
+    Returns ``(q, scale)`` with ``q * scale ~= w``; all-zero channels get
+    scale 1 so dequant is exact there.
+    """
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+#: Matmul-weight leaf names: ``kernel`` (dense layers) and the MoE
+#: expert matrices.  All are consumed through quantization-aware code
+#: (layers.dense_apply / materialize_matrix, moe._mlp).
+_MATMUL_NAMES = ("kernel", "wi", "wg", "wo")
+
+
+def _eligible(name: str, leaf) -> bool:
+    if name not in _MATMUL_NAMES + ("table",):
+        return False
+    if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+        return False
+    if name == "kernel" and leaf.ndim > 3:
+        # 4-D conv kernels (ResNet) are consumed by lax.conv directly —
+        # leave them full precision rather than break the consumer.
+        return False
+    if name == "table" and leaf.ndim != 2:
+        return False
+    if not jnp.issubdtype(leaf.dtype, jnp.floating):
+        return False
+    return leaf.size >= MIN_QUANT_ELEMENTS
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize every eligible ``kernel``/``table`` leaf in a param tree.
+
+    An eligible leaf ``{"kernel": w}`` becomes ``{"kernel_q": int8,
+    "kernel_scale": f32}`` (same for ``table``); everything else passes
+    through untouched.  ``layers.dense_apply`` / ``embedding_apply`` /
+    ``transformer.head_table`` consume both forms transparently.
+    """
+    if not isinstance(params, dict):
+        return params
+    out: Dict[str, Any] = {}
+    for name, value in params.items():
+        if isinstance(value, dict):
+            out[name] = quantize_params(value)
+        elif _eligible(name, value):
+            axis = -1 if name == "table" else -2
+            q, scale = quantize_array(value, axis=axis)
+            out[f"{name}_q"] = q
+            out[f"{name}_scale"] = scale
+        else:
+            out[name] = value
+    return out
+
+
+def dequantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`quantize_params` (up to rounding): full-width
+    tree with the original leaf names."""
+    if not isinstance(params, dict):
+        return params
+    out: Dict[str, Any] = {}
+    for name, value in params.items():
+        if isinstance(value, dict):
+            out[name] = dequantize_params(value)
+        elif name.endswith("_q"):
+            base = name[:-2]
+            out[base] = (
+                value.astype(jnp.float32) * params[f"{base}_scale"]
+            )
+        elif name.endswith("_scale") and f"{name[:-6]}_q" in params:
+            continue
+        else:
+            out[name] = value
+    return out
+
+
+def param_bytes(params: Dict[str, Any]) -> int:
+    """Total stored bytes of a param tree (quantized or not)."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(params)
+        if hasattr(leaf, "size")
+    )

@@ -376,8 +376,23 @@ def head_table(params, config: TransformerConfig):
     embedding table [V, D] (logits = x @ table^T); "dv" = dense head
     kernel [D, V]."""
     if config.tied_embeddings:
-        return params["embed"]["table"], "vd"
+        embed = params["embed"]
+        if "table_q" in embed:
+            # Weight-only int8 (models/quantization.py): dequant here;
+            # XLA fuses the multiply into the consuming head matmul.
+            return (
+                embed["table_q"].astype(jnp.float32)
+                * embed["table_scale"].astype(jnp.float32),
+                "vd",
+            )
+        return embed["table"], "vd"
     head = params["head"]
+    if "kernel_q" in head:
+        return (
+            head["kernel_q"].astype(jnp.float32)
+            * head["kernel_scale"].astype(jnp.float32),
+            "dv",
+        )
     extra = set(head) - {"kernel"}
     if extra:
         # A bias (or any new head param) would be silently dropped by a

@@ -266,6 +266,61 @@ def _ab_fused_ce_main() -> int:
     return 0
 
 
+def _ab_decode_main() -> int:
+    """CloudLM SMALL decode: full-precision vs int8 weight-only.
+
+    Decode is HBM-bound (every token re-reads every weight); int8
+    storage halves the bytes vs bf16.  tokens/sec for both, one JSON
+    line per completed variant.
+    """
+    import functools
+
+    import jax
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    from cloud_tpu.models import generation, quantization, transformer
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"phase": "decode_quant_ab", "ok": False,
+                          "error": "backend is not tpu"}), flush=True)
+        return 1
+
+    cfg = transformer.SMALL
+    b, t_prompt, new = 4, 128, 128
+    params = jax.device_put(transformer.init(jax.random.PRNGKey(0), cfg))
+    rng = np.random.default_rng(0)
+    prompts = jax.device_put(
+        rng.integers(1, cfg.vocab_size, (b, t_prompt)).astype(np.int32)
+    )
+    lens = jax.device_put(np.full((b,), t_prompt, np.int32))
+
+    out = {"phase": "decode_quant_ab", "ok": True, "ab": {},
+           "config": f"SMALL b{b} prompt{t_prompt} new{new}"}
+    variants = {
+        "full": params,
+        "int8": jax.device_put(quantization.quantize_params(params)),
+    }
+    for name, p in variants.items():
+        run = jax.jit(functools.partial(
+            generation.generate, config=cfg, max_new_tokens=new, mesh=None,
+        ))
+        result = run(p, prompts, lens)
+        float(result["sequences"].astype(np.float32).sum())  # compile
+        iters = 4
+        start = time.monotonic()
+        for _ in range(iters):
+            result = run(p, prompts, lens)
+            float(result["sequences"].astype(np.float32).sum())
+        elapsed = time.monotonic() - start
+        out["ab"][name] = {
+            "tokens_per_sec": round(iters * b * new / elapsed, 1),
+            "param_bytes": quantization.param_bytes(p),
+        }
+        print(json.dumps(out), flush=True)
+    return 0
+
+
 def _ab_gn_main() -> int:
     """ResNet50-CIFAR b256: GroupNorm kernel + fusions vs pure XLA.
 
@@ -369,6 +424,7 @@ def _cycle(bench, state) -> bool:
         ("--ab", "bert_opt_ab"),
         ("--ab-fused-ce", "lm_fused_ce_ab"),
         ("--ab-gn", "resnet_gn_ab"),
+        ("--ab-decode", "decode_quant_ab"),
     ):
         try:
             proc = bench._hardened_run(
@@ -422,6 +478,8 @@ if __name__ == "__main__":
         sys.exit(_ab_fused_ce_main())
     if "--ab-gn" in sys.argv:
         sys.exit(_ab_gn_main())
+    if "--ab-decode" in sys.argv:
+        sys.exit(_ab_decode_main())
     if "--ab" in sys.argv:
         sys.exit(_ab_main())
     sys.exit(main())
