@@ -77,9 +77,8 @@ def default_jax_pin() -> Optional[str]:
     """
     import sys
 
-    if "jax" in sys.modules:
-        version = sys.modules["jax"].__version__
-    else:
+    version = getattr(sys.modules.get("jax"), "__version__", None)
+    if version is None:
         try:
             import importlib.metadata
 

@@ -98,7 +98,17 @@ def materialize_matrix(params, name: str, dtype):
 
 def dense_apply(params, x, *, dtype=None):
     dtype = dtype or x.dtype
-    y = jnp.einsum("...i,io->...o", x, materialize_matrix(params, "kernel", dtype))
+    if "kernel_q" in params:
+        # Post-scale formulation: y = (x @ q) * scale.  The int8 kernel
+        # feeds the matmul directly (a full-width q*scale intermediate
+        # would be loop-invariant inside a decode scan and LICM could
+        # hoist it, materializing the wide matrix once and streaming it
+        # every step); the per-channel scale applies to the small output.
+        q = params["kernel_q"].astype(dtype)
+        scale = jnp.squeeze(params["kernel_scale"], axis=-2).astype(dtype)
+        y = jnp.einsum("...i,io->...o", x, q) * scale
+    else:
+        y = jnp.einsum("...i,io->...o", x, params["kernel"].astype(dtype))
     if "bias" in params:
         y = y + params["bias"].astype(dtype)
     return y
@@ -125,10 +135,14 @@ def embedding_apply(params, token_ids, *, dtype=jnp.float32,
     if "table_q" in params:
         # Weight-only int8: gather narrow rows, then scale the gathered
         # rows (per-row scales) — the full-width table never materializes.
-        rows = jnp.take(params["table_q"], token_ids, axis=0).astype(dtype)
-        scales = jnp.take(
-            params["table_scale"].astype(dtype), token_ids, axis=0
-        )
+        # Same replicate constraint as the full-precision path: a sharded
+        # table makes SPMD involuntarily rematerialize at the gather.
+        table_q = shard_constraint(params["table_q"], None, None,
+                                   rules=rules, mesh=mesh)
+        table_scale = shard_constraint(params["table_scale"], None, None,
+                                       rules=rules, mesh=mesh)
+        rows = jnp.take(table_q, token_ids, axis=0).astype(dtype)
+        scales = jnp.take(table_scale.astype(dtype), token_ids, axis=0)
         out = rows * scales
     else:
         table = params["table"].astype(dtype)

@@ -17,8 +17,10 @@ Scheme (symmetric, per-channel):
 * embedding tables ``[V, D]``: scale over ``axis=-1`` (per row/token,
   shape ``[V, 1]``) — correct for BOTH uses of the table: the lookup
   (gather rows, scale rows) and the tied LM head (x @ table^T: rows are
-  the vocab output channels).  Positional tables read by slice (BERT/
-  ViT ``pos``) go through ``layers.materialize_matrix`` at apply time.
+  the vocab output channels).  BERT's positional table (read by slice)
+  goes through ``layers.materialize_matrix`` at apply time; ViT's
+  positional embedding is a BARE leaf named ``pos`` — ineligible by
+  naming, left untouched.
 
 Inference-only: quantized trees feed ``generation.generate`` /
 ``transformer.apply``; the training stack expects full-precision params

@@ -378,38 +378,54 @@ def head_table(params, config: TransformerConfig):
     if config.tied_embeddings:
         embed = params["embed"]
         if "table_q" in embed:
-            # Weight-only int8 (models/quantization.py): dequant here;
-            # XLA fuses the multiply into the consuming head matmul.
-            return (
-                embed["table_q"].astype(jnp.float32)
-                * embed["table_scale"].astype(jnp.float32),
-                "vd",
-            )
+            # Weight-only int8 (models/quantization.py): materialize at
+            # full width for table consumers (fused_ce's chunked scan);
+            # lm_logits takes the post-scale fast path instead.
+            return layers.materialize_matrix(embed, "table", jnp.float32), "vd"
         return embed["table"], "vd"
     head = params["head"]
-    if "kernel_q" in head:
-        return (
-            head["kernel_q"].astype(jnp.float32)
-            * head["kernel_scale"].astype(jnp.float32),
-            "dv",
-        )
-    extra = set(head) - {"kernel"}
+    extra = set(head) - {"kernel", "kernel_q", "kernel_scale"}
     if extra:
         # A bias (or any new head param) would be silently dropped by a
-        # bare-table consumer; fail loudly instead.
+        # bare-table consumer; fail loudly instead — quantized or not.
         raise NotImplementedError(
             f"head has params beyond 'kernel' ({sorted(extra)}); "
             "head_table/fused_ce support bias-free heads only"
         )
+    if "kernel_q" in head:
+        return layers.materialize_matrix(head, "kernel", jnp.float32), "dv"
     return head["kernel"], "dv"
 
 
 def lm_logits(params, x, config: TransformerConfig) -> jnp.ndarray:
     """Final vocabulary projection in f32 (tying via :func:`head_table`,
-    shared with the generation path and the fused-CE loss)."""
+    shared with the generation path and the fused-CE loss).
+
+    Quantized heads take the post-scale path — ``(x @ q) * scale`` —
+    so the int8 matrix feeds the matmul directly: a full-width
+    ``q * scale`` intermediate would be loop-invariant inside the decode
+    scan, and LICM hoisting it would stream the wide table every token.
+    """
+    x = x.astype(jnp.float32)
+    if config.tied_embeddings and "table_q" in params["embed"]:
+        embed = params["embed"]
+        logits = jnp.einsum(
+            "...d,vd->...v", x, embed["table_q"].astype(jnp.float32)
+        )
+        return logits * embed["table_scale"][:, 0].astype(jnp.float32)
+    if not config.tied_embeddings and "kernel_q" in params["head"]:
+        head = params["head"]
+        extra = set(head) - {"kernel_q", "kernel_scale"}
+        if extra:
+            raise NotImplementedError(
+                f"quantized head has extra params {sorted(extra)}"
+            )
+        logits = jnp.einsum(
+            "...d,dv->...v", x, head["kernel_q"].astype(jnp.float32)
+        )
+        return logits * head["kernel_scale"][0].astype(jnp.float32)
     table, layout = head_table(params, config)
     table = table.astype(jnp.float32)
-    x = x.astype(jnp.float32)
     if layout == "vd":
         return jnp.einsum("...d,vd->...v", x, table)
     return jnp.einsum("...d,dv->...v", x, table)

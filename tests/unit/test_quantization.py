@@ -204,3 +204,39 @@ class TestOtherModelTrees:
             float(jnp.std(full)) + 1e-6
         )
         assert rel < 0.5, rel
+
+
+class TestReviewRegressions:
+    def test_quantized_biased_head_fails_loudly(self):
+        """The bias guard must hold for quantized heads too (a silent
+        drop was possible when the kernel_q branch returned early)."""
+        cfg = transformer.TINY.scaled(dtype=jnp.float32, num_layers=1)
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        params["head"]["bias"] = jnp.zeros((cfg.vocab_size,))
+        qparams = quantization.quantize_params(params)
+        assert "kernel_q" in qparams["head"] and "bias" in qparams["head"]
+        x = jnp.zeros((1, 2, cfg.dim), jnp.float32)
+        with pytest.raises(NotImplementedError, match="head has params"):
+            transformer.head_table(qparams, cfg)
+        with pytest.raises(NotImplementedError):
+            transformer.lm_logits(qparams, x, cfg)
+
+    @pytest.mark.parametrize("tied", [False, True])
+    def test_post_scale_logits_match_materialized(self, tied):
+        """lm_logits' post-scale fast path == projecting the materialized
+        dequantized table (the formulation exists so no full-width table
+        is ever loop-invariant inside the decode scan)."""
+        cfg = transformer.TINY.scaled(
+            dtype=jnp.float32, num_layers=1, tied_embeddings=tied
+        )
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        qparams = quantization.quantize_params(params)
+        x = _w((2, 3, cfg.dim), seed=9, scale=1.0)
+        got = transformer.lm_logits(qparams, x, cfg)
+        table, layout = transformer.head_table(qparams, cfg)
+        eq = "...d,vd->...v" if layout == "vd" else "...d,dv->...v"
+        want = jnp.einsum(eq, x.astype(jnp.float32),
+                          table.astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
