@@ -96,9 +96,19 @@ def save_pretrained(directory: str, params: Any, config: Any) -> None:
 
     import shutil
 
+    import jax
+
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
     config_json = _config_to_json(config)
+    leaf_paths, _ = jax.tree_util.tree_flatten_with_path(params)
+    if any(
+        str(getattr(path[-1], "key", "")).endswith("_q")
+        for path, _leaf in leaf_paths
+    ):
+        # Weight-only int8 bundle (models/quantization.py): stamp it so
+        # load_pretrained builds the quantized tree structure.
+        config_json["quantized"] = True
     bundle_dir = os.path.join(directory, "bundle")
     staging = bundle_dir + ".saving"
     retired = bundle_dir + ".old"
@@ -201,9 +211,19 @@ def load_pretrained(
         # orbax flags unsafe across topologies — a bundle saved on a
         # mesh must load on a single inference box).
         module = importlib.import_module(obj["module"])
-        template = jax.eval_shape(
-            lambda rng: module.init(rng, config), jax.random.PRNGKey(0)
-        )
+
+        def build(rng):
+            params = module.init(rng, config)
+            if obj.get("quantized"):
+                # eval_shape through quantize_params reproduces the int8
+                # bundle's exact tree structure without materializing
+                # anything.
+                from cloud_tpu.models import quantization
+
+                params = quantization.quantize_params(params)
+            return params
+
+        template = jax.eval_shape(build, jax.random.PRNGKey(0))
         sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
         template = jax.tree_util.tree_map(
             lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
