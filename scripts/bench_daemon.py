@@ -435,6 +435,13 @@ def _cycle(bench, state) -> bool:
         ("--ab-gn", "resnet_gn_ab"),
         ("--ab-decode", "decode_quant_ab"),
     ):
+        if _driver_active(bench):
+            # The chip is exclusive to one process: a queued A/B child
+            # would make the just-started driver's probes fail for the
+            # rest of this cycle.  Yield mid-cycle, not just between
+            # cycles.
+            _log("driver run became active; yielding before " + phase)
+            break
         try:
             proc = bench._hardened_run(
                 [sys.executable, os.path.abspath(__file__), flag],
