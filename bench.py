@@ -462,40 +462,20 @@ def _check_group_norm(extras):
 def _measure_decode(extras):
     """Generation decode throughput: CloudLM SMALL (124M, GPT-2 shape),
     KV-cache greedy decode, tokens/sec — the capability's perf number
-    (BASELINE.md had none).  Chain-then-read applies: the sequences
-    output depends on every decode step, so one host read pays for the
-    whole chained run."""
-    import functools
-    import time as time_mod
-
-    import jax
-    import numpy as np
-
-    from cloud_tpu.models import generation, transformer
-
-    cfg = transformer.SMALL
-    b, t_prompt, new = 4, 128, 128
-    params = transformer.init(jax.random.PRNGKey(0), cfg)
-    params = jax.device_put(params)
-    rng = np.random.default_rng(0)
-    prompts = jax.device_put(
-        rng.integers(1, cfg.vocab_size, (b, t_prompt)).astype(np.int32)
+    (BASELINE.md had none).  Workload + timing shared with the daemon's
+    quantization A/B (cloud_tpu/utils/benchmarking.py)."""
+    from cloud_tpu.utils.benchmarking import (
+        decode_setup,
+        decode_tokens_per_sec,
     )
-    lens = jax.device_put(np.full((b,), t_prompt, np.int32))
 
-    run = jax.jit(functools.partial(
-        generation.generate, config=cfg, max_new_tokens=new, mesh=None,
-    ))
-    out = run(params, prompts, lens)
-    float(out["sequences"].astype(np.float32).sum())  # warmup + compile
-    iters = 4
-    start = time_mod.perf_counter()
-    acc = 0.0
-    for _ in range(iters):
-        out = run(params, prompts, lens)
-        acc += float(out["sequences"].astype(np.float32).sum())
-    elapsed = time_mod.perf_counter() - start
-    tokens_per_sec = iters * b * new / elapsed
+    b, t_prompt, new = 4, 128, 128
+    cfg, params, prompts, lens = decode_setup(
+        batch_size=b, prompt_len=t_prompt
+    )
+    tokens_per_sec = decode_tokens_per_sec(
+        params, cfg, prompts, lens, max_new_tokens=new
+    )
     extras["decode_tokens_per_sec"] = round(tokens_per_sec, 1)
     extras["decode_config"] = f"SMALL b{b} prompt{t_prompt} new{new}"
 

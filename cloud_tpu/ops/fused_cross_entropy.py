@@ -159,10 +159,10 @@ def fused_linear_cross_entropy(
     Returns the scalar mean loss.  Compute is f32 regardless of input
     dtypes (matching ``lm_logits``' f32 head).
     """
+    import math
+
     lead = targets.shape
-    n = 1
-    for dim in lead:
-        n *= dim
+    n = math.prod(lead)
     nll = _fused_nll(
         x.reshape(n, x.shape[-1]),
         table,

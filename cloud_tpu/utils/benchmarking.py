@@ -33,6 +33,59 @@ def chain_then_read_throughput(step, state, batch, *, warmup=3, iters=20):
     return iters / (time.perf_counter() - start)
 
 
+def decode_setup(*, batch_size: int = 4, prompt_len: int = 128,
+                 params=None):
+    """The generation-decode benchmark workload, built ONCE for every
+    measurer (bench.py's decode phase and the daemon's quantization A/B
+    must time the SAME config): CloudLM SMALL, device-resident params
+    and right-aligned full-length prompts.  Returns
+    ``(config, params, prompts, lens)``."""
+    import jax
+    import numpy as np
+
+    from cloud_tpu.models import transformer
+
+    cfg = transformer.SMALL
+    if params is None:
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+    params = jax.device_put(params)
+    rng = np.random.default_rng(0)
+    prompts = jax.device_put(
+        rng.integers(1, cfg.vocab_size,
+                     (batch_size, prompt_len)).astype(np.int32)
+    )
+    lens = jax.device_put(np.full((batch_size,), prompt_len, np.int32))
+    return cfg, params, prompts, lens
+
+
+def decode_tokens_per_sec(params, cfg, prompts, lens, *, max_new_tokens,
+                          warmup: int = 1, iters: int = 4):
+    """Greedy KV-cache decode throughput with the chain-then-read wait
+    (each iteration's sequences are host-read, which a hung tunnel
+    cannot satisfy early)."""
+    import functools
+    import time as time_mod
+
+    import jax
+    import numpy as np
+
+    from cloud_tpu.models import generation
+
+    run = jax.jit(functools.partial(
+        generation.generate, config=cfg, max_new_tokens=max_new_tokens,
+        mesh=None,
+    ))
+    for _ in range(warmup):
+        out = run(params, prompts, lens)
+        float(out["sequences"].astype(np.float32).sum())
+    start = time_mod.perf_counter()
+    for _ in range(iters):
+        out = run(params, prompts, lens)
+        float(out["sequences"].astype(np.float32).sum())
+    elapsed = time_mod.perf_counter() - start
+    return iters * prompts.shape[0] * max_new_tokens / elapsed
+
+
 def resnet_train_setup(*, imagenet_shape: bool, batch_size: int):
     """The ResNet benchmark workload, built ONCE for every measurer.
 

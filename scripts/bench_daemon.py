@@ -131,6 +131,18 @@ def _append_record(bench, record: dict) -> None:
         f.write(json.dumps(record) + "\n")
 
 
+def _require_tpu(phase: str) -> bool:
+    """Shared A/B-child guard: refuse (with the standard line) off-TPU."""
+    import jax
+
+    sys.path.insert(0, REPO)
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"phase": phase, "ok": False,
+                          "error": "backend is not tpu"}), flush=True)
+        return False
+    return True
+
+
 # --------------------------------------------------------------------------
 # --ab child: BERT optimizer-state A/B on the device.
 
@@ -149,17 +161,14 @@ def _ab_main() -> int:
     import numpy as np
     import optax
 
-    sys.path.insert(0, REPO)
+    if not _require_tpu("bert_opt_ab"):
+        return 1
     from cloud_tpu.models import bert
     from cloud_tpu.training import optimizers as opt_lib
     from cloud_tpu.training import train as train_lib
     from cloud_tpu.utils.benchmarking import chain_then_read_throughput
 
     bench = _load_bench()
-    if jax.default_backend() != "tpu":
-        print(json.dumps({"phase": "bert_opt_ab", "ok": False,
-                          "error": "backend is not tpu"}), flush=True)
-        return 1
 
     cfg = bert.BERT_BASE
     flops = bench._bert_analytic_flops(cfg, AB_BATCH, AB_SEQ)
@@ -216,15 +225,11 @@ def _ab_fused_ce_main() -> int:
     import numpy as np
     import optax
 
-    sys.path.insert(0, REPO)
+    if not _require_tpu("lm_fused_ce_ab"):
+        return 1
     from cloud_tpu.models import transformer
     from cloud_tpu.training import train as train_lib
     from cloud_tpu.utils.benchmarking import chain_then_read_throughput
-
-    if jax.default_backend() != "tpu":
-        print(json.dumps({"phase": "lm_fused_ce_ab", "ok": False,
-                          "error": "backend is not tpu"}), flush=True)
-        return 1
 
     b, t = 4, 1024
     base = transformer.SMALL.scaled(tied_embeddings=True)
@@ -273,28 +278,21 @@ def _ab_decode_main() -> int:
     storage halves the bytes vs bf16.  tokens/sec for both, one JSON
     line per completed variant.
     """
-    import functools
-
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
-    sys.path.insert(0, REPO)
-    from cloud_tpu.models import generation, quantization, transformer
-
-    if jax.default_backend() != "tpu":
-        print(json.dumps({"phase": "decode_quant_ab", "ok": False,
-                          "error": "backend is not tpu"}), flush=True)
+    if not _require_tpu("decode_quant_ab"):
         return 1
-
-    cfg = transformer.SMALL
-    b, t_prompt, new = 4, 128, 128
-    params = jax.device_put(transformer.init(jax.random.PRNGKey(0), cfg))
-    rng = np.random.default_rng(0)
-    prompts = jax.device_put(
-        rng.integers(1, cfg.vocab_size, (b, t_prompt)).astype(np.int32)
+    from cloud_tpu.models import quantization
+    from cloud_tpu.utils.benchmarking import (
+        decode_setup,
+        decode_tokens_per_sec,
     )
-    lens = jax.device_put(np.full((b,), t_prompt, np.int32))
+
+    b, t_prompt, new = 4, 128, 128
+    cfg, params, prompts, lens = decode_setup(
+        batch_size=b, prompt_len=t_prompt
+    )
 
     out = {"phase": "decode_quant_ab", "ok": True, "ab": {},
            "config": f"SMALL b{b} prompt{t_prompt} new{new}"}
@@ -311,19 +309,10 @@ def _ab_decode_main() -> int:
         "int8": jax.device_put(quantization.quantize_params(params)),
     }
     for name, p in variants.items():
-        run = jax.jit(functools.partial(
-            generation.generate, config=cfg, max_new_tokens=new, mesh=None,
-        ))
-        result = run(p, prompts, lens)
-        float(result["sequences"].astype(np.float32).sum())  # compile
-        iters = 4
-        start = time.monotonic()
-        for _ in range(iters):
-            result = run(p, prompts, lens)
-            float(result["sequences"].astype(np.float32).sum())
-        elapsed = time.monotonic() - start
         out["ab"][name] = {
-            "tokens_per_sec": round(iters * b * new / elapsed, 1),
+            "tokens_per_sec": round(decode_tokens_per_sec(
+                p, cfg, prompts, lens, max_new_tokens=new
+            ), 1),
             "param_bytes": quantization.param_bytes(p),
         }
         print(json.dumps(out), flush=True)
@@ -339,18 +328,12 @@ def _ab_gn_main() -> int:
     is read at trace time, so two separately-built steps in one process
     measure both paths.  Prints one JSON line per completed variant.
     """
-    import jax
-
-    sys.path.insert(0, REPO)
+    if not _require_tpu("resnet_gn_ab"):
+        return 1
     from cloud_tpu.utils.benchmarking import (
         chain_then_read_throughput,
         resnet_train_setup,
     )
-
-    if jax.default_backend() != "tpu":
-        print(json.dumps({"phase": "resnet_gn_ab", "ok": False,
-                          "error": "backend is not tpu"}), flush=True)
-        return 1
 
     out = {"phase": "resnet_gn_ab", "ok": True, "ab": {}}
     for name, env_val in (("kernel_fused", "1"), ("xla", "0")):

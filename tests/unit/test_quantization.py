@@ -20,6 +20,16 @@ def _w(shape, seed=0, scale=0.5):
     return jnp.asarray(rng.normal(size=shape) * scale, jnp.float32)
 
 
+def _rel_err(got, want):
+    """max |got - want| measured against want's scale (plain rtol fails
+    spuriously on near-zero entries of wide matmul outputs)."""
+    got = jnp.asarray(got, jnp.float32)
+    want = jnp.asarray(want, jnp.float32)
+    return float(jnp.max(jnp.abs(got - want))) / (
+        float(jnp.std(want)) + 1e-6
+    )
+
+
 class TestScheme:
     def test_roundtrip_error_bound(self):
         w = _w((64, 512), seed=1)
@@ -72,12 +82,7 @@ class TestConsumers:
         x = _w((8, 64), seed=5, scale=1.0)
         full = layers.dense_apply(params, x)
         quant = layers.dense_apply(qparams, x)
-        # Per-element rounding errors accumulate over the 64-wide
-        # contraction; judge the error against the OUTPUT's scale (a
-        # plain rtol fails spuriously on near-zero entries).
-        rel = float(jnp.max(jnp.abs(quant - full))) / (
-            float(jnp.std(full)) + 1e-6
-        )
+        rel = _rel_err(quant, full)
         assert rel < 0.05, rel
 
     def test_embedding_apply_quantized_matches_dequant_exactly(self):
@@ -106,8 +111,7 @@ class TestEndToEnd:
         full, _ = transformer.apply(params, tokens, cfg, mesh=None)
         quant, _ = transformer.apply(qparams, tokens, cfg, mesh=None)
         # int8 weights perturb logits; they must stay close in scale.
-        denom = float(jnp.std(full)) + 1e-6
-        rel = float(jnp.max(jnp.abs(quant - full))) / denom
+        rel = _rel_err(quant, full)
         assert rel < 0.35, rel
 
     def test_quantized_generate_runs_and_mostly_agrees(self):
@@ -163,9 +167,7 @@ class TestOtherModelTrees:
         full = bert.apply(params, tokens, cfg=cfg)
         quant = bert.apply(qparams, tokens, cfg=cfg)
         assert quant.shape == full.shape
-        rel = float(jnp.max(jnp.abs(
-            quant.astype(jnp.float32) - full.astype(jnp.float32)
-        ))) / (float(jnp.std(full.astype(jnp.float32))) + 1e-6)
+        rel = _rel_err(quant, full)
         assert rel < 0.5, rel
 
     def test_resnet_tree_conv_kernels_untouched(self):
@@ -200,9 +202,7 @@ class TestOtherModelTrees:
         )
         full, _ = transformer.apply(params, tokens, cfg, mesh=None)
         quant, _ = transformer.apply(qparams, tokens, cfg, mesh=None)
-        rel = float(jnp.max(jnp.abs(quant - full))) / (
-            float(jnp.std(full)) + 1e-6
-        )
+        rel = _rel_err(quant, full)
         assert rel < 0.5, rel
 
 
