@@ -319,6 +319,60 @@ def _ab_decode_main() -> int:
     return 0
 
 
+def _ab_bert_s512_main() -> int:
+    """BERT-base b32 x s512: the long-sequence fine-tune point.
+
+    Runs on the flash-kernel dispatch (the XLA path OOMs here — 12
+    layers of f32[B,H,T,T] softmax residuals exceed HBM; BASELINE.md
+    row 3b).  The r3 in-session number (6.4 steps/s, 30.2% MFU) has
+    never been driver/daemon-verified.  One JSON line.
+    """
+    import functools
+
+    import jax
+    import numpy as np
+    import optax
+
+    if not _require_tpu("bert_s512"):
+        return 1
+    from cloud_tpu.models import bert
+    from cloud_tpu.training import train as train_lib
+    from cloud_tpu.utils.benchmarking import chain_then_read_throughput
+
+    bench = _load_bench()
+    cfg = bert.BERT_BASE
+    b, s = 32, 512
+    tx = optax.adamw(2e-5)
+    state = train_lib.create_sharded_state(
+        jax.random.PRNGKey(0), functools.partial(bert.init, cfg=cfg),
+        tx, mesh=None,
+    )
+    step = train_lib.make_train_step(
+        functools.partial(bert.loss_fn, cfg=cfg), tx
+    )
+    rng = np.random.default_rng(0)
+    batch = jax.device_put({
+        "tokens": rng.integers(0, cfg.vocab_size, (b, s)).astype(np.int32),
+        "label": rng.integers(0, 2, b).astype(np.int64),
+    })
+    compiled = step.lower(state, batch).compile()
+    steps_per_sec = chain_then_read_throughput(
+        compiled, state, batch, warmup=2, iters=10
+    )
+    flops = bench._bert_analytic_flops(cfg, b, s)
+    peak = bench._peak_bf16_tflops(jax.devices()[0])
+    out = {"phase": "bert_s512", "ok": True, "batch": b, "seq": s,
+           "ab": {"flash_path": {
+               "steps_per_sec": round(steps_per_sec, 3),
+           }}}
+    if peak:
+        out["ab"]["flash_path"]["mfu"] = round(
+            flops * steps_per_sec / 1e12 / peak, 4
+        )
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def _ab_gn_main() -> int:
     """ResNet50-CIFAR b256: GroupNorm kernel + fusions vs pure XLA.
 
@@ -417,6 +471,7 @@ def _cycle(bench, state) -> bool:
         ("--ab-fused-ce", "lm_fused_ce_ab"),
         ("--ab-gn", "resnet_gn_ab"),
         ("--ab-decode", "decode_quant_ab"),
+        ("--ab-bert-s512", "bert_s512"),
     ):
         if _driver_active(bench):
             # The chip is exclusive to one process: a queued A/B child
@@ -479,6 +534,8 @@ if __name__ == "__main__":
         sys.exit(_ab_gn_main())
     if "--ab-decode" in sys.argv:
         sys.exit(_ab_decode_main())
+    if "--ab-bert-s512" in sys.argv:
+        sys.exit(_ab_bert_s512_main())
     if "--ab" in sys.argv:
         sys.exit(_ab_main())
     sys.exit(main())

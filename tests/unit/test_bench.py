@@ -356,3 +356,30 @@ def test_driver_headline_preferred_over_daemon(bench, monkeypatch, capsys):
     record = _emitted(capsys)
     assert record["value"] == 171.4
     assert "source" not in record
+
+
+def test_hardened_run_survives_pipe_holding_grandchild(bench):
+    """The round-5 wedge, reproduced: a timed-out child leaves a
+    GRANDCHILD holding the stdout pipe.  subprocess.run would block
+    forever in its post-kill drain; _hardened_run must SIGKILL the
+    process group and return promptly with the partial output."""
+    import textwrap
+    import time as time_mod
+
+    child = textwrap.dedent("""
+        import os, subprocess, sys, time
+        print("phase-line-before-hang", flush=True)
+        # Grandchild inherits our stdout and never exits on its own.
+        subprocess.Popen([sys.executable, "-c", "import time; time.sleep(600)"])
+        time.sleep(600)
+    """)
+    start = time_mod.perf_counter()
+    with pytest.raises(subprocess.TimeoutExpired) as exc_info:
+        bench._hardened_run([sys.executable, "-c", child], timeout=3)
+    elapsed = time_mod.perf_counter() - start
+    assert elapsed < 25, f"drain wedged for {elapsed:.0f}s"
+    # Partial output printed before the hang is salvaged.
+    out = exc_info.value.output
+    if isinstance(out, bytes):
+        out = out.decode()
+    assert "phase-line-before-hang" in (out or "")
