@@ -102,30 +102,71 @@ def sample_logits(rng, logits, sample: SampleConfig, *, seen=None,
 
 
 def _init_cache(config: transformer.TransformerConfig, b: int, s: int,
-                rules: ShardingRules, mesh):
+                rules: ShardingRules, mesh, kv_quant: bool = False):
+    """KV cache pytree [L, B, S, H, hd].
+
+    ``kv_quant=True`` stores K/V as int8 with per-(position, head) f32
+    scales [L, B, S, H, 1] — the cache is re-read WHOLE every decode
+    step, so at long context its bytes are the decode bandwidth; int8
+    quarters them vs f32 (halves vs a bf16 cache).  The scales ride the
+    same pytree so every cache operation (scan slicing, beam repeat/
+    reorder) is a tree_map.
+    """
     shape = (config.num_layers, b, s, config.num_heads, config.head_dim)
-    k = jnp.zeros(shape, config.dtype)
-    v = jnp.zeros(shape, config.dtype)
-    k = shard_constraint(k, None, "batch", None, "heads", None,
-                         rules=rules, mesh=mesh)
-    v = shard_constraint(v, None, "batch", None, "heads", None,
-                         rules=rules, mesh=mesh)
-    return {"k": k, "v": v}
+
+    def constrain(x):
+        return shard_constraint(x, None, "batch", None, "heads", None,
+                                rules=rules, mesh=mesh)
+
+    if not kv_quant:
+        return {"k": constrain(jnp.zeros(shape, config.dtype)),
+                "v": constrain(jnp.zeros(shape, config.dtype))}
+    scale_shape = shape[:-1] + (1,)
+    return {
+        "k": constrain(jnp.zeros(shape, jnp.int8)),
+        "k_scale": constrain(jnp.ones(scale_shape, jnp.float32)),
+        "v": constrain(jnp.zeros(shape, jnp.int8)),
+        "v_scale": constrain(jnp.ones(scale_shape, jnp.float32)),
+    }
 
 
-def _cache_attention(q, k_cache, v_cache, cur_len):
-    """q [B, Tq, H, hd] against the cache [B, S, H, hd]; key j of row i is
-    valid iff j < cur_len[i].  f32 softmax, finite mask value (matching
-    ops.flash_attention's semantics for fully-masked rows)."""
+def _quantize_kv(x):
+    """Per-(..., head) vector int8: returns (q, scale[..., 1])."""
+    from cloud_tpu.models.quantization import quantize_array
+
+    return quantize_array(x, axis=-1)
+
+
+def _cache_attention(q, cache_l, cur_len):
+    """q [B, Tq, H, hd] against the layer cache {k, v[, *_scale]}
+    [B, S, H, hd]; key j of row i is valid iff j < cur_len[i].  f32
+    softmax, finite mask value (matching ops.flash_attention's semantics
+    for fully-masked rows).
+
+    Quantized caches use POST-SCALE algebra — scores = (q . k_q) *
+    k_scale folded into the [B, H, Tq, S] scores, and v_scale folded
+    into the softmax weights — so the int8 arrays feed the einsums
+    directly and no dequantized full-width cache ever materializes.
+    """
+    k_cache, v_cache = cache_l["k"], cache_l["v"]
     s = k_cache.shape[1]
     scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def fold(scores_like, kv_scale):
+        # [B, S, H, 1] -> [B, H, 1, S] broadcast over the query dim.
+        return scores_like * jnp.transpose(kv_scale, (0, 2, 3, 1))
+
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32),
         k_cache.astype(jnp.float32),
     ) * scale
+    if "k_scale" in cache_l:
+        scores = fold(scores, cache_l["k_scale"])
     valid = jnp.arange(s)[None, :] < cur_len[:, None]  # [B, S]
     scores = jnp.where(valid[:, None, None, :], scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1)
+    if "v_scale" in cache_l:
+        weights = fold(weights, cache_l["v_scale"])
     out = jnp.einsum(
         "bhqk,bkhd->bqhd", weights, v_cache.astype(jnp.float32)
     )
@@ -139,8 +180,7 @@ def _mlp(layer_params, y, config, rules):
     return layers.mlp_block_apply(layer_params["mlp"], y, rules=rules)
 
 
-def _decode_layer(layer_params, x, k_cache_l, v_cache_l, cur_len, config,
-                  rules):
+def _decode_layer(layer_params, x, cache_l, cur_len, config, rules):
     """One block on a single-token slice x [B, 1, D]; writes this step's
     k/v at position cur_len[i] and attends over the whole valid prefix
     (including the just-written position)."""
@@ -150,16 +190,25 @@ def _decode_layer(layer_params, x, k_cache_l, v_cache_l, cur_len, config,
         layer_params["att"], y, cur_len[:, None], config
     )
     rows = jnp.arange(b)
-    k_cache_l = k_cache_l.at[rows, cur_len].set(k_new[:, 0])
-    v_cache_l = v_cache_l.at[rows, cur_len].set(v_new[:, 0])
-    attended = _cache_attention(q, k_cache_l, v_cache_l, cur_len + 1)
+    cache_l = dict(cache_l)
+    if "k_scale" in cache_l:
+        k_q, k_sc = _quantize_kv(k_new[:, 0])
+        v_q, v_sc = _quantize_kv(v_new[:, 0])
+        cache_l["k"] = cache_l["k"].at[rows, cur_len].set(k_q)
+        cache_l["k_scale"] = cache_l["k_scale"].at[rows, cur_len].set(k_sc)
+        cache_l["v"] = cache_l["v"].at[rows, cur_len].set(v_q)
+        cache_l["v_scale"] = cache_l["v_scale"].at[rows, cur_len].set(v_sc)
+    else:
+        cache_l["k"] = cache_l["k"].at[rows, cur_len].set(k_new[:, 0])
+        cache_l["v"] = cache_l["v"].at[rows, cur_len].set(v_new[:, 0])
+    attended = _cache_attention(q, cache_l, cur_len + 1)
     att_out = layers.dense_apply(
         layer_params["att"]["out"], attended.reshape(b, 1, -1)
     )
     x = x + att_out
     y = layers.rmsnorm_apply(layer_params["ln2"], x)
     x = x + _mlp(layer_params, y, config, rules)
-    return x, k_cache_l, v_cache_l
+    return x, cache_l
 
 
 def _prefill_layer(layer_params, x, positions, prompt_mask, config, rules,
@@ -194,13 +243,14 @@ def _final_logits(params, x, config):
     return transformer.lm_logits(params, x, config)
 
 
-def _prefill(params, prompt_tokens, prompt_lens, config, s, rules, mesh):
+def _prefill(params, prompt_tokens, prompt_lens, config, s, rules, mesh,
+             kv_quant: bool = False):
     """One full forward over the prompt buffer: returns the KV cache
     (size ``s``, positions [0, prompt_len) filled) and the next-token
     logits [B, V] at each row's last real prompt position — shared by
     sampling and beam decoding."""
     b, t_prompt = prompt_tokens.shape
-    cache = _init_cache(config, b, s, rules, mesh)
+    cache = _init_cache(config, b, s, rules, mesh, kv_quant=kv_quant)
     positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
     prompt_mask = (positions < prompt_lens[:, None]).astype(jnp.int32)
     x = layers.embedding_apply(params["embed"], prompt_tokens,
@@ -218,12 +268,23 @@ def _prefill(params, prompt_tokens, prompt_lens, config, s, rules, mesh):
     x, (k_pref, v_pref) = jax.lax.scan(
         prefill_body, x, (params["layers"],)
     )
-    cache["k"] = jax.lax.dynamic_update_slice(
-        cache["k"], k_pref.astype(config.dtype), (0, 0, 0, 0, 0)
-    )
-    cache["v"] = jax.lax.dynamic_update_slice(
-        cache["v"], v_pref.astype(config.dtype), (0, 0, 0, 0, 0)
-    )
+    zeros5 = (0, 0, 0, 0, 0)
+    if kv_quant:
+        for name, pref in (("k", k_pref), ("v", v_pref)):
+            q, sc = _quantize_kv(pref)
+            cache[name] = jax.lax.dynamic_update_slice(
+                cache[name], q, zeros5
+            )
+            cache[f"{name}_scale"] = jax.lax.dynamic_update_slice(
+                cache[f"{name}_scale"], sc, zeros5
+            )
+    else:
+        cache["k"] = jax.lax.dynamic_update_slice(
+            cache["k"], k_pref.astype(config.dtype), zeros5
+        )
+        cache["v"] = jax.lax.dynamic_update_slice(
+            cache["v"], v_pref.astype(config.dtype), zeros5
+        )
     last_idx = (prompt_lens - 1)[:, None, None]
     last_x = jnp.take_along_axis(
         x, jnp.broadcast_to(last_idx, (b, 1, x.shape[-1])), axis=1
@@ -243,6 +304,7 @@ def generate(
     rng: Optional[jax.Array] = None,
     rules: ShardingRules = DEFAULT_RULES,
     mesh=None,
+    kv_quant: bool = False,
 ) -> Dict[str, Any]:
     """Generate ``max_new_tokens`` continuations for a batch of prompts.
 
@@ -253,6 +315,10 @@ def generate(
       max_new_tokens: static decode trip count.
       sample: sampling configuration; default greedy.
       rng: PRNG key (required unless greedy).
+      kv_quant: store the KV cache int8 with per-(position, head)
+        scales (_init_cache docstring) — the long-context decode
+        bandwidth knob; combine with int8 weights
+        (models/quantization.py) for fully-narrow decoding.
 
     Returns dict with:
       ``tokens``: [B, max_new_tokens] generated ids — eos included where
@@ -286,7 +352,7 @@ def generate(
         }
     s = t_prompt + max_new_tokens
     cache, logits0 = _prefill(params, prompt_tokens, prompt_lens, config,
-                              s, rules, mesh)
+                              s, rules, mesh, kv_quant=kv_quant)
     rng, step_rng = jax.random.split(rng)
     track_seen = sample.repetition_penalty != 1.0
     # Static gate: the allow-eos masking only enters the compiled loop
@@ -306,7 +372,7 @@ def generate(
     # ``post_eos`` marks tokens STRICTLY after an eos: the eos itself is a
     # real emitted token; later slots are pads whose compute is discarded.
     def step(carry, i):
-        cache_k, cache_v, cur_len, token, post_eos, seen, rng = carry
+        cache, cur_len, token, post_eos, seen, rng = carry
         x = layers.embedding_apply(
             params["embed"], token[:, None], dtype=config.dtype,
             rules=rules, mesh=mesh,
@@ -314,14 +380,14 @@ def generate(
         x = x * math.sqrt(config.dim)
 
         def layer_body(x, layer_slice):
-            layer_params, k_l, v_l = layer_slice
-            x, k_l, v_l = _decode_layer(
-                layer_params, x, k_l, v_l, cur_len, config, rules
+            layer_params, cache_l = layer_slice
+            x, cache_l = _decode_layer(
+                layer_params, x, cache_l, cur_len, config, rules
             )
-            return x, (k_l, v_l)
+            return x, cache_l
 
-        x, (cache_k, cache_v) = jax.lax.scan(
-            layer_body, x, (params["layers"], cache_k, cache_v)
+        x, cache = jax.lax.scan(
+            layer_body, x, (params["layers"], cache)
         )
         logits = _final_logits(params, x, config)[:, 0]
         rng, step_rng = jax.random.split(rng)
@@ -345,16 +411,16 @@ def generate(
         cur_len = cur_len + jnp.where(post_eos, 0, 1)
         emitted = jnp.where(post_eos, jnp.int32(sample.pad_id), token)
         return (
-            cache_k, cache_v, cur_len, next_tok, done, seen, rng
+            cache, cur_len, next_tok, done, seen, rng
         ), emitted
 
     # N-1 scan steps: step i consumes carried token i and samples token
     # i+1, so the last carried token needs no forward pass of its own —
     # it is emitted (and counted) directly from the final carry.  (With
     # max_new_tokens=1 the scan body never runs; tok0 came from prefill.)
-    carry0 = (cache["k"], cache["v"], prompt_lens, tok0,
+    carry0 = (cache, prompt_lens, tok0,
               jnp.zeros((b,), bool), seen0, rng)
-    (_, _, cur_len, last_tok, last_post, _, _), emitted = jax.lax.scan(
+    (_, cur_len, last_tok, last_post, _, _), emitted = jax.lax.scan(
         step, carry0, jnp.arange(max_new_tokens - 1)
     )
     final_emit = jnp.where(last_post, jnp.int32(sample.pad_id), last_tok)
@@ -414,6 +480,7 @@ def beam_search(
     pad_id: int = 0,
     rules: ShardingRules = DEFAULT_RULES,
     mesh=None,
+    kv_quant: bool = False,
 ) -> Dict[str, Any]:
     """Beam decoding: the highest-scoring continuation per prompt.
 
@@ -456,11 +523,12 @@ def beam_search(
         )
 
     cache, logits0 = _prefill(params, prompt_tokens, prompt_lens, config,
-                              s, rules, mesh)
+                              s, rules, mesh, kv_quant=kv_quant)
 
     # Tile the cache/prompt state to B*K (beam-major inside each batch row).
-    cache_k = jnp.repeat(cache["k"], k, axis=1)  # [L, B*K, S, H, hd]
-    cache_v = jnp.repeat(cache["v"], k, axis=1)
+    cache = jax.tree_util.tree_map(
+        lambda a: jnp.repeat(a, k, axis=1), cache
+    )  # leaves [L, B*K, S, H, ...]
     cur_len = jnp.repeat(prompt_lens, k)  # [B*K]
 
     # Seed the live set with the top-K first tokens.  An eos seed moves
@@ -483,7 +551,7 @@ def beam_search(
         scores_l = jnp.where(seed_eos, neg_inf, scores_l)
 
     def step(carry, i):
-        (cache_k, cache_v, cur_len, token, scores_l, hist_l, n_l,
+        (cache, cur_len, token, scores_l, hist_l, n_l,
          scores_f, hist_f, n_f) = carry
         x = layers.embedding_apply(
             params["embed"], token.reshape(b * k)[:, None],
@@ -492,14 +560,14 @@ def beam_search(
         x = x * math.sqrt(config.dim)
 
         def layer_body(x, layer_slice):
-            layer_params, k_l, v_l = layer_slice
-            x, k_l, v_l = _decode_layer(
-                layer_params, x, k_l, v_l, cur_len, config, rules
+            layer_params, cache_l = layer_slice
+            x, cache_l = _decode_layer(
+                layer_params, x, cache_l, cur_len, config, rules
             )
-            return x, (k_l, v_l)
+            return x, cache_l
 
-        x, (cache_k, cache_v) = jax.lax.scan(
-            layer_body, x, (params["layers"], cache_k, cache_v)
+        x, cache = jax.lax.scan(
+            layer_body, x, (params["layers"], cache)
         )
         logprobs = jax.nn.log_softmax(
             _final_logits(params, x, config)[:, 0], axis=-1
@@ -549,17 +617,18 @@ def beam_search(
         flat_parent = (
             jnp.arange(b)[:, None] * k + live_parent
         ).reshape(b * k)
-        cache_k = jnp.take(cache_k, flat_parent, axis=1)
-        cache_v = jnp.take(cache_v, flat_parent, axis=1)
+        cache = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, flat_parent, axis=1), cache
+        )
         cur_len = jnp.take(cur_len, flat_parent) + 1
         return (
-            cache_k, cache_v, cur_len, next_tok, scores_l, hist_l, n_l,
+            cache, cur_len, next_tok, scores_l, hist_l, n_l,
             scores_f, hist_f, n_f,
         ), None
 
-    carry0 = (cache_k, cache_v, cur_len, tok0, scores_l, hist_l, n_l,
+    carry0 = (cache, cur_len, tok0, scores_l, hist_l, n_l,
               scores_f, hist_f, n_f)
-    (_, _, _, _, scores_l, hist_l, n_l, scores_f, hist_f, n_f), _ = (
+    (_, _, _, scores_l, hist_l, n_l, scores_f, hist_f, n_f), _ = (
         jax.lax.scan(step, carry0, jnp.arange(max_new_tokens - 1))
     )
 

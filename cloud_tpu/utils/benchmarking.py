@@ -59,7 +59,8 @@ def decode_setup(*, batch_size: int = 4, prompt_len: int = 128,
 
 
 def decode_tokens_per_sec(params, cfg, prompts, lens, *, max_new_tokens,
-                          warmup: int = 1, iters: int = 4):
+                          warmup: int = 1, iters: int = 4,
+                          kv_quant: bool = False):
     """Greedy KV-cache decode throughput with the chain-then-read wait
     (each iteration's sequences are host-read, which a hung tunnel
     cannot satisfy early)."""
@@ -73,7 +74,7 @@ def decode_tokens_per_sec(params, cfg, prompts, lens, *, max_new_tokens,
 
     run = jax.jit(functools.partial(
         generation.generate, config=cfg, max_new_tokens=max_new_tokens,
-        mesh=None,
+        mesh=None, kv_quant=kv_quant,
     ))
     for _ in range(warmup):
         out = run(params, prompts, lens)

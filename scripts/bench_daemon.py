@@ -144,7 +144,44 @@ def _require_tpu(phase: str) -> bool:
 
 
 # --------------------------------------------------------------------------
-# --ab child: BERT optimizer-state A/B on the device.
+# --ab children: BERT scaffolding shared by the optimizer-width and
+# long-sequence phases.
+
+
+def _bert_step_throughput(b, s, tx, *, warmup=AB_WARMUP, iters=AB_ITERS):
+    """Build BERT-base state/step at (b, s), AOT-compile, chain-then-read.
+
+    Returns (steps_per_sec, analytic_flops_per_step, peak_tflops)."""
+    import functools
+
+    import jax
+    import numpy as np
+
+    from cloud_tpu.models import bert
+    from cloud_tpu.training import train as train_lib
+    from cloud_tpu.utils.benchmarking import chain_then_read_throughput
+
+    bench = _load_bench()
+    cfg = bert.BERT_BASE
+    state = train_lib.create_sharded_state(
+        jax.random.PRNGKey(0), functools.partial(bert.init, cfg=cfg),
+        tx, mesh=None,
+    )
+    step = train_lib.make_train_step(
+        functools.partial(bert.loss_fn, cfg=cfg), tx
+    )
+    rng = np.random.default_rng(0)
+    batch = jax.device_put({
+        "tokens": rng.integers(0, cfg.vocab_size, (b, s)).astype(np.int32),
+        "label": rng.integers(0, 2, b).astype(np.int64),
+    })
+    compiled = step.lower(state, batch).compile()
+    steps_per_sec = chain_then_read_throughput(
+        compiled, state, batch, warmup=warmup, iters=iters
+    )
+    flops = bench._bert_analytic_flops(cfg, b, s)
+    peak = bench._peak_bf16_tflops(jax.devices()[0])
+    return steps_per_sec, flops, peak
 
 
 def _ab_main() -> int:
@@ -155,31 +192,11 @@ def _ab_main() -> int:
     bf16 both moments (cast_state; nu narrowing is the risky one, measured
     for the traffic datapoint only).  Prints ONE JSON line.
     """
-    import functools
-
-    import jax
-    import numpy as np
     import optax
 
     if not _require_tpu("bert_opt_ab"):
         return 1
-    from cloud_tpu.models import bert
     from cloud_tpu.training import optimizers as opt_lib
-    from cloud_tpu.training import train as train_lib
-    from cloud_tpu.utils.benchmarking import chain_then_read_throughput
-
-    bench = _load_bench()
-
-    cfg = bert.BERT_BASE
-    flops = bench._bert_analytic_flops(cfg, AB_BATCH, AB_SEQ)
-    peak = bench._peak_bf16_tflops(jax.devices()[0])
-    rng = np.random.default_rng(0)
-    batch = jax.device_put({
-        "tokens": rng.integers(
-            0, cfg.vocab_size, (AB_BATCH, AB_SEQ)
-        ).astype(np.int32),
-        "label": rng.integers(0, 2, AB_BATCH).astype(np.int64),
-    })
 
     variants = {
         "f32": optax.adamw(2e-5),
@@ -189,16 +206,8 @@ def _ab_main() -> int:
     out = {"phase": "bert_opt_ab", "ok": True, "ab": {},
            "batch": AB_BATCH, "seq": AB_SEQ}
     for name, tx in variants.items():
-        state = train_lib.create_sharded_state(
-            jax.random.PRNGKey(0), functools.partial(bert.init, cfg=cfg),
-            tx, mesh=None,
-        )
-        step = train_lib.make_train_step(
-            functools.partial(bert.loss_fn, cfg=cfg), tx
-        )
-        compiled = step.lower(state, batch).compile()
-        steps_per_sec = chain_then_read_throughput(
-            compiled, state, batch, warmup=AB_WARMUP, iters=AB_ITERS
+        steps_per_sec, flops, peak = _bert_step_throughput(
+            AB_BATCH, AB_SEQ, tx
         )
         entry = {"steps_per_sec": round(steps_per_sec, 3),
                  "ms_per_step": round(1000.0 / steps_per_sec, 3)}
@@ -304,14 +313,21 @@ def _ab_decode_main() -> int:
         if jnp.issubdtype(w.dtype, jnp.floating) else w,
         params,
     )
+    qparams = jax.device_put(quantization.quantize_params(params))
     variants = {
-        "bf16": jax.device_put(bf16_params),
-        "int8": jax.device_put(quantization.quantize_params(params)),
+        "bf16": (jax.device_put(bf16_params), False),
+        "int8": (qparams, False),
+        # int8 weights + int8 KV cache: validates the fully-narrow
+        # decode path compiles and runs on real Mosaic/XLA (the cache is
+        # small vs weights at this prompt length; its bandwidth win
+        # shows at long context).
+        "int8_kv": (qparams, True),
     }
-    for name, p in variants.items():
+    for name, (p, kv_quant) in variants.items():
         out["ab"][name] = {
             "tokens_per_sec": round(decode_tokens_per_sec(
-                p, cfg, prompts, lens, max_new_tokens=new
+                p, cfg, prompts, lens, max_new_tokens=new,
+                kv_quant=kv_quant,
             ), 1),
             "param_bytes": quantization.param_bytes(p),
         }
@@ -327,40 +343,14 @@ def _ab_bert_s512_main() -> int:
     row 3b).  The r3 in-session number (6.4 steps/s, 30.2% MFU) has
     never been driver/daemon-verified.  One JSON line.
     """
-    import functools
-
-    import jax
-    import numpy as np
     import optax
 
     if not _require_tpu("bert_s512"):
         return 1
-    from cloud_tpu.models import bert
-    from cloud_tpu.training import train as train_lib
-    from cloud_tpu.utils.benchmarking import chain_then_read_throughput
-
-    bench = _load_bench()
-    cfg = bert.BERT_BASE
     b, s = 32, 512
-    tx = optax.adamw(2e-5)
-    state = train_lib.create_sharded_state(
-        jax.random.PRNGKey(0), functools.partial(bert.init, cfg=cfg),
-        tx, mesh=None,
+    steps_per_sec, flops, peak = _bert_step_throughput(
+        b, s, optax.adamw(2e-5), iters=10
     )
-    step = train_lib.make_train_step(
-        functools.partial(bert.loss_fn, cfg=cfg), tx
-    )
-    rng = np.random.default_rng(0)
-    batch = jax.device_put({
-        "tokens": rng.integers(0, cfg.vocab_size, (b, s)).astype(np.int32),
-        "label": rng.integers(0, 2, b).astype(np.int64),
-    })
-    compiled = step.lower(state, batch).compile()
-    steps_per_sec = chain_then_read_throughput(
-        compiled, state, batch, warmup=2, iters=10
-    )
-    flops = bench._bert_analytic_flops(cfg, b, s)
-    peak = bench._peak_bf16_tflops(jax.devices()[0])
     out = {"phase": "bert_s512", "ok": True, "batch": b, "seq": s,
            "ab": {"flash_path": {
                "steps_per_sec": round(steps_per_sec, 3),
