@@ -1,0 +1,105 @@
+"""Property-based fuzzing of the round-5 numerics (hypothesis).
+
+The example-based suites pin specific shapes; these properties hold for
+ARBITRARY (bounded) shapes/chunkings, which is where off-by-one padding
+and mask bugs live: the fused cross-entropy must equal the naive path
+for every (N, D, V, chunk), and int8 quantization must respect its
+per-channel error bound for every layout.
+
+Kept cheap (small max_examples, no deadline — CI boxes jit-compile) and
+slow-marked: the default local run keeps its ~7 min budget.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from cloud_tpu.models import quantization
+from cloud_tpu.ops.fused_cross_entropy import fused_linear_cross_entropy
+
+pytestmark = pytest.mark.slow
+
+_SETTINGS = dict(max_examples=25, deadline=None)
+
+
+@settings(**_SETTINGS)
+@given(
+    n=st.integers(1, 6),
+    d=st.integers(1, 9),
+    v=st.integers(2, 70),
+    chunk=st.integers(1, 80),
+    layout=st.sampled_from(["vd", "dv"]),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_fused_ce_matches_naive_everywhere(n, d, v, chunk, layout, seed):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    table_vd = jnp.asarray(rng.normal(size=(v, d)), jnp.float32)
+    table = table_vd if layout == "vd" else table_vd.T
+    targets = jnp.asarray(rng.integers(0, v, (n,)))
+
+    got = fused_linear_cross_entropy(
+        x, table, targets, table_layout=layout, chunk_size=chunk
+    )
+    logits = x @ table_vd.T
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    want = jnp.mean(
+        -jnp.take_along_axis(lp, targets[:, None], axis=-1)[:, 0]
+    )
+    np.testing.assert_allclose(
+        float(got), float(want), rtol=1e-5, atol=1e-6
+    )
+
+
+@settings(**_SETTINGS)
+@given(
+    n=st.integers(2, 5),
+    d=st.integers(1, 8),
+    v=st.integers(2, 40),
+    chunk=st.integers(1, 50),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_fused_ce_grads_match_naive_everywhere(n, d, v, chunk, seed):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    table = jnp.asarray(rng.normal(size=(v, d)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, v, (n,)))
+
+    def fused(x, t):
+        return fused_linear_cross_entropy(
+            x, t, targets, chunk_size=chunk
+        )
+
+    def naive(x, t):
+        lp = jax.nn.log_softmax(x @ t.T, axis=-1)
+        return jnp.mean(
+            -jnp.take_along_axis(lp, targets[:, None], axis=-1)[:, 0]
+        )
+
+    got = jax.grad(fused, argnums=(0, 1))(x, table)
+    want = jax.grad(naive, argnums=(0, 1))(x, table)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-6
+        )
+
+
+@settings(**_SETTINGS)
+@given(
+    rows=st.integers(1, 12),
+    cols=st.integers(1, 33),
+    axis=st.sampled_from([-1, -2]),
+    scale=st.floats(1e-3, 1e3),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_quantize_error_bound_everywhere(rows, cols, axis, scale, seed):
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.normal(size=(rows, cols)) * scale, jnp.float32)
+    q, sc = quantization.quantize_array(w, axis=axis)
+    err = np.abs(np.asarray(q.astype(jnp.float32) * sc - w))
+    bound = np.asarray(sc) / 2 * (1 + 1e-6) + 1e-9
+    assert (err <= np.broadcast_to(bound, err.shape)).all()
+    assert q.dtype == jnp.int8
+    assert int(np.max(np.abs(np.asarray(q)))) <= 127
