@@ -131,7 +131,10 @@ def _compile_step(step, state, batch):
     from XLA cost analysis (fwd+bwd of the exact HLO that runs); None when
     the backend can't report it.
     """
-    compiled = step.lower(state, batch).compile()
+    from cloud_tpu.monitoring import tracing
+
+    with tracing.span("bench/compile"):
+        compiled = step.lower(state, batch).compile()
     flops = None
     try:
         analysis = compiled.cost_analysis()
@@ -165,11 +168,13 @@ def _throughput(step, state, batch, *, warmup, iters):
     """Chain-then-read timing; single source of truth lives in
     cloud_tpu/utils/benchmarking.py (imported in the child, where
     cloud_tpu is already on the path)."""
+    from cloud_tpu.monitoring import tracing
     from cloud_tpu.utils.benchmarking import chain_then_read_throughput
 
-    return chain_then_read_throughput(
-        step, state, batch, warmup=warmup, iters=iters
-    )
+    with tracing.span("bench/measure", warmup=warmup, iters=iters):
+        return chain_then_read_throughput(
+            step, state, batch, warmup=warmup, iters=iters
+        )
 
 
 def _emit_phase(phase, **payload):
@@ -482,6 +487,12 @@ def _measure_decode(extras):
 
 def _child_main() -> int:
     """Headline first; every phase prints its own salvageable JSON line."""
+    # Span tracing on for the whole child: compile vs measure wall-clock
+    # lands in the BENCH json (span_aggregates below) so the perf
+    # trajectory gains phase attribution alongside the headline.
+    from cloud_tpu.monitoring import tracing
+
+    tracing.enable()
     extras = {}
     # Phase 1: the headline.  GroupNorm kernel state comes from the
     # environment (parent disables it on a retry after a headline-less
@@ -534,6 +545,20 @@ def _child_main() -> int:
                 tag, ok=False,
                 error=f"{type(exc).__name__}: {exc}"[:500],
             )
+
+    # Last line: phase-latency aggregates for everything spanned above
+    # (bench/compile, bench/measure, plus any framework spans).  Rounded —
+    # these are attribution context, not the measurement.
+    spans = {
+        name: {
+            "count": agg["count"],
+            "total_s": round(agg["total_seconds"], 3),
+            "mean_s": round(agg["mean_seconds"], 4),
+            "max_s": round(agg["max_seconds"], 4),
+        }
+        for name, agg in sorted(tracing.aggregates().items())
+    }
+    _emit_phase("spans", ok=True, extras={"span_aggregates": spans})
     return 0
 
 

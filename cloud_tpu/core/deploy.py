@@ -50,6 +50,7 @@ def startup_script(
     process_id_base: int,
     monitoring: bool = True,
     profiler_port: Optional[int] = None,
+    submit_ts: Optional[float] = None,
 ) -> str:
     """TPU-VM startup script: pull + run the training container on each host.
 
@@ -68,6 +69,10 @@ def startup_script(
     series belong), so building this script needs no ADC locally.
     ``profiler_port`` additionally gates the on-demand profiler server
     (bootstrap reads CLOUD_TPU_PROFILER_PORT; --net=host exposes it).
+    ``submit_ts`` (wall-clock unix seconds of run()'s submission) rides
+    into the container as CLOUD_TPU_SUBMIT_TS so the remote trainer's
+    first completed step can publish the true end-to-end
+    ``run/submit_to_first_step_seconds`` gauge (monitoring.tracing).
     """
     lines = [
         "#! /bin/bash",
@@ -96,6 +101,8 @@ def startup_script(
         ]
     if profiler_port:
         lines.append(f"  -e CLOUD_TPU_PROFILER_PORT={int(profiler_port)} \\")
+    if submit_ts is not None:
+        lines.append(f"  -e CLOUD_TPU_SUBMIT_TS={submit_ts!r} \\")
     lines.append(f"  {image_uri}")
     return "\n".join(lines)
 
@@ -111,6 +118,7 @@ def build_node_request(
     service_account: Optional[str] = None,
     monitoring: bool = True,
     profiler_port: Optional[int] = None,
+    submit_ts: Optional[float] = None,
 ) -> dict:
     """The TPU v2 API Node body for one slice (golden-tested)."""
     topo = config.tpu_topology()
@@ -125,6 +133,7 @@ def build_node_request(
                 process_id_base=process_id_base,
                 monitoring=monitoring,
                 profiler_port=profiler_port,
+                submit_ts=submit_ts,
             )
         },
         "labels": dict(job_labels or {}),
@@ -145,6 +154,7 @@ def build_job_request(
     service_account: Optional[str] = None,
     monitoring: bool = True,
     profiler_port: Optional[int] = None,
+    submit_ts: Optional[float] = None,
 ) -> dict:
     """All node bodies for a (multi-)slice job, keyed by node id.
 
@@ -168,6 +178,7 @@ def build_job_request(
             service_account=service_account,
             monitoring=monitoring,
             profiler_port=profiler_port,
+            submit_ts=submit_ts,
         )
     return {"job_id": job_id, "nodes": nodes}
 

@@ -51,6 +51,17 @@ def quantize_array(w: jnp.ndarray, *, axis: int) -> Tuple[jnp.ndarray,
     """
     w32 = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
+    # A NaN/inf amax fails the `amax > 0` test, so scale would become 1.0
+    # and round(NaN) -> int8 is undefined: a corrupted checkpoint would
+    # round-trip as noise.  Fail loudly instead (eager inputs only —
+    # quantization is an inference-prep step, never inside jit).
+    if not isinstance(amax, jax.core.Tracer) and not bool(
+        jnp.all(jnp.isfinite(amax))
+    ):
+        raise ValueError(
+            "quantize_array: non-finite values in weights (amax is NaN/inf);"
+            " refusing to quantize a corrupted array"
+        )
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return q, scale
@@ -113,9 +124,14 @@ def dequantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
             out[name] = dequantize_params(value)
         elif name.endswith("_q"):
             base = name[:-2]
-            out[base] = (
-                value.astype(jnp.float32) * params[f"{base}_scale"]
-            )
+            scale = params.get(f"{base}_scale")
+            if scale is None:
+                # Not a quantize_params product (a genuine param whose name
+                # ends in "_q", or a hand-edited/truncated tree): pass the
+                # leaf through untouched instead of KeyError-ing.
+                out[name] = value
+            else:
+                out[base] = value.astype(jnp.float32) * scale
         elif name.endswith("_scale") and f"{name[:-6]}_q" in params:
             continue
         else:

@@ -24,9 +24,23 @@ from cloud_tpu.monitoring.exporter import (
     start_exporter,
     stop_exporter,
 )
-from cloud_tpu.monitoring import profiler
+from cloud_tpu.monitoring import tracing
 
 import time as _time
+
+
+def __getattr__(name):
+    # Lazy: profiler imports jax at module level; spelling it eagerly here
+    # would put jax on the import path of every tracing/metrics consumer
+    # (training.data, core.run).  ``monitoring.profiler`` still resolves.
+    # importlib, not ``from ... import``: the from-import form asks the
+    # package for the attribute first, which re-enters this __getattr__
+    # and recurses until the interpreter gives up.
+    if name == "profiler":
+        import importlib
+
+        return importlib.import_module("cloud_tpu.monitoring.profiler")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class MetricsCallback:
@@ -123,9 +137,11 @@ __all__ = [
     "counter_inc",
     "distribution_record",
     "gauge_set",
-    "profiler",
+    # "profiler" deliberately absent: a star-import must not defeat the
+    # lazy __getattr__ and drag jax onto every consumer's import path.
     "reset",
     "snapshot",
     "start_exporter",
     "stop_exporter",
+    "tracing",
 ]

@@ -1,6 +1,9 @@
 #include "wire_client.h"
 
 #include <dlfcn.h>
+#include <signal.h>
+#include <sys/wait.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <cstdio>
@@ -299,15 +302,66 @@ struct CurlApi {
   bool ok = false;
 };
 
+void* DlopenCurl() {
+  for (const char* name :
+       {"libcurl.so.4", "libcurl-gnutls.so.4", "libcurl.so"}) {
+    // RTLD_LOCAL, never GLOBAL: every entry point is resolved through
+    // dlsym, and promoting libcurl's dependency chain (OpenSSL) into
+    // the global namespace collides with other SSL runtimes already in
+    // the process.
+    void* lib = dlopen(name, RTLD_NOW | RTLD_LOCAL);
+    if (lib != nullptr) return lib;
+  }
+  return nullptr;
+}
+
+// Loading libcurl pulls in an SSL runtime whose initialization can
+// corrupt the heap when the host process already carries a conflicting
+// one (observed: grpc's boringssl alongside OpenSSL-linked libcurl —
+// SIGSEGV / "corrupted double-linked list" abort, killing the whole
+// process).  Monitoring must never take the job down, so sacrifice a
+// forked child to find out: the child replicates this process's exact
+// library state, performs the dangerous dlopen + curl_global_init, and
+// reports back via its exit status.  Crash or hang in the child ⇒ the
+// wire client declares itself unavailable and the exporter falls back
+// to the Python transport.
+bool CurlLoadsSafely() {
+  pid_t pid = fork();
+  if (pid < 0) return true;  // cannot probe; keep the old direct path
+  if (pid == 0) {
+    // The host (a Python process) may have its own SIGALRM disposition;
+    // the inherited handler would swallow the alarm instead of killing
+    // the wedged child, so restore the default first.
+    signal(SIGALRM, SIG_DFL);
+    alarm(10);  // a wedged child must not wedge the parent's waitpid
+    void* lib = DlopenCurl();
+    if (lib == nullptr) _exit(1);
+    auto global_init =
+        reinterpret_cast<int (*)(long)>(dlsym(lib, "curl_global_init"));
+    if (global_init != nullptr) global_init(3L /* CURL_GLOBAL_ALL */);
+    _exit(0);
+  }
+  // Timed reap: the child's alarm is backup, not the only bound — the
+  // parent must never block in waitpid on a child that cannot die.
+  int status = 0;
+  for (int waited_ms = 0; waited_ms < 12000; waited_ms += 50) {
+    pid_t reaped = waitpid(pid, &status, WNOHANG);
+    if (reaped == pid) {
+      return WIFEXITED(status) && WEXITSTATUS(status) == 0;
+    }
+    if (reaped < 0) return true;  // cannot observe; keep the direct path
+    usleep(50 * 1000);
+  }
+  kill(pid, SIGKILL);
+  waitpid(pid, &status, 0);
+  return false;  // hung probe: the load is not safe here
+}
+
 CurlApi& Curl() {
   static CurlApi* api = [] {
     auto* a = new CurlApi();
-    void* lib = nullptr;
-    for (const char* name :
-         {"libcurl.so.4", "libcurl-gnutls.so.4", "libcurl.so"}) {
-      lib = dlopen(name, RTLD_NOW | RTLD_GLOBAL);
-      if (lib != nullptr) break;
-    }
+    if (!CurlLoadsSafely()) return a;
+    void* lib = DlopenCurl();
     if (lib == nullptr) return a;
     a->easy_init = reinterpret_cast<void* (*)()>(dlsym(lib, "curl_easy_init"));
     a->easy_setopt = reinterpret_cast<int (*)(void*, int, ...)>(

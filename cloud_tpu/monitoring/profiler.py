@@ -96,19 +96,33 @@ def trace(logdir: Optional[str] = None, *, perfetto_link: bool = False):
     supported by the underlying writer, so traces can land next to the
     job's checkpoints.
     """
+    from cloud_tpu.monitoring import tracing
+
     logdir = logdir or default_logdir()
     with jax.profiler.trace(logdir, create_perfetto_link=perfetto_link):
-        yield logdir
+        # Host-side tracing spans opened inside the block mirror
+        # themselves as TraceAnnotations onto the device timeline.
+        tracing.xprof_trace_started()
+        try:
+            yield logdir
+        finally:
+            tracing.xprof_trace_stopped()
 
 
 def start_trace(logdir: Optional[str] = None) -> str:
+    from cloud_tpu.monitoring import tracing
+
     logdir = logdir or default_logdir()
     jax.profiler.start_trace(logdir)
+    tracing.xprof_trace_started()
     return logdir
 
 
 def stop_trace() -> None:
+    from cloud_tpu.monitoring import tracing
+
     jax.profiler.stop_trace()
+    tracing.xprof_trace_stopped()
 
 
 def annotate(name: str, **kwargs):

@@ -1,0 +1,131 @@
+"""Phase-latency breakdown of a tracing timeline dump.
+
+``python -m cloud_tpu.monitoring.report /path/to/timeline.json`` prints a
+per-span-name table (count, total, mean, p50, max, % of wall) from a
+Chrome trace-event file written by ``tracing.dump_timeline``.  The same
+summarization is importable as :class:`TraceReport` for programmatic use
+(bench.py ships the equivalent aggregates in its BENCH json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def _percentile(sorted_values: List[float], q: float) -> float:
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1, int(q * (len(sorted_values) - 1) + 0.5))
+    return sorted_values[idx]
+
+
+class TraceReport:
+    """Aggregates complete ("ph": "X") events from a timeline dump."""
+
+    def __init__(self, events: List[dict]):
+        self.events = [
+            e for e in events
+            if e.get("ph") == "X" and isinstance(e.get("dur"), (int, float))
+        ]
+
+    @classmethod
+    def from_file(cls, path: str) -> "TraceReport":
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        events = doc["traceEvents"] if isinstance(doc, dict) else doc
+        return cls(events)
+
+    def wall_seconds(self) -> float:
+        """End of the last span minus start of the first (timeline span)."""
+        if not self.events:
+            return 0.0
+        start = min(e["ts"] for e in self.events)
+        end = max(e["ts"] + e["dur"] for e in self.events)
+        return (end - start) / 1e6
+
+    def rows(self) -> List[Dict[str, float]]:
+        """One row per span name, sorted by total time descending."""
+        by_name: Dict[str, List[float]] = {}
+        for event in self.events:
+            by_name.setdefault(event["name"], []).append(event["dur"] / 1e6)
+        wall = self.wall_seconds()
+        rows = []
+        for name, durations in by_name.items():
+            durations.sort()
+            total = sum(durations)
+            rows.append({
+                "name": name,
+                "count": len(durations),
+                "total_s": total,
+                "mean_s": total / len(durations),
+                "p50_s": _percentile(durations, 0.5),
+                "max_s": durations[-1],
+                "pct_wall": 100.0 * total / wall if wall else 0.0,
+            })
+        rows.sort(key=lambda r: r["total_s"], reverse=True)
+        return rows
+
+    def render(self) -> str:
+        rows = self.rows()
+        header = ("span", "count", "total", "mean", "p50", "max", "% wall")
+        table = [header] + [
+            (
+                r["name"],
+                str(r["count"]),
+                _fmt_s(r["total_s"]),
+                _fmt_s(r["mean_s"]),
+                _fmt_s(r["p50_s"]),
+                _fmt_s(r["max_s"]),
+                f"{r['pct_wall']:.1f}",
+            )
+            for r in rows
+        ]
+        widths = [max(len(row[i]) for row in table) for i in range(len(header))]
+        lines = []
+        for i, row in enumerate(table):
+            lines.append("  ".join(
+                cell.ljust(w) if j == 0 else cell.rjust(w)
+                for j, (cell, w) in enumerate(zip(row, widths))
+            ))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+        lines.append("")
+        lines.append(
+            f"{len(self.events)} spans over {_fmt_s(self.wall_seconds())} "
+            "of timeline"
+        )
+        return "\n".join(lines)
+
+
+def _fmt_s(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:.2f}s"
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:.1f}ms"
+    return f"{seconds * 1e6:.0f}us"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m cloud_tpu.monitoring.report",
+        description="Summarize a tracing.dump_timeline() Chrome-trace file.",
+    )
+    parser.add_argument("timeline", help="path to timeline.json")
+    args = parser.parse_args(argv)
+    try:
+        report = TraceReport.from_file(args.timeline)
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"could not read {args.timeline!r}: {exc}", file=sys.stderr)
+        return 2
+    if not report.events:
+        print("no spans in timeline (was tracing enabled?)")
+        return 0
+    print(report.render())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

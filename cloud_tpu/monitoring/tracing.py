@@ -1,0 +1,477 @@
+"""Host-side span tracing across the launch-and-train pipeline.
+
+The metrics registry answers "how often / how large"; this module answers
+"where did the wall-clock go".  A span is a named host-side phase
+(``run/validate``, ``step/compute``, ``checkpoint/save`` ...) opened as a
+context manager or decorator.  Every finished span is recorded three ways:
+
+* as a ``span/<name>`` distribution in the metrics registry
+  (``monitoring.metrics``), so the exporter ships phase latencies like any
+  other series;
+* into an in-process timeline ring buffer, exportable as Chrome
+  trace-event JSON via :func:`dump_timeline` (open in ``chrome://tracing``
+  / Perfetto) and summarizable with ``python -m cloud_tpu.monitoring.report``;
+* when a ``jax.profiler`` trace is active (``monitoring.profiler`` keeps
+  the flag), mirrored as a ``TraceAnnotation`` so host phases line up with
+  device activity on the XProf timeline.
+
+Disabled is the default and costs ~nothing: without an active collector
+:func:`span` returns a shared no-op context manager — one function call,
+no allocation, no clock read (< 1 µs; asserted in tests/unit/test_tracing.py)
+— so permanent instrumentation in hot paths (per-step phases, collectives)
+is safe.  Enable with :func:`enable` / the :func:`collecting` context
+manager, or the ``CLOUD_TPU_TRACE=1`` env gate (same idiom as
+``CLOUD_TPU_MONITORING_ENABLED``).
+
+The north-star composite metric lives here too: :func:`mark_submit` is
+called by ``core.run.run()`` when a job is submitted, and the trainer's
+first completed step calls :func:`record_submit_to_first_step`, which
+publishes the ``run/submit_to_first_step_seconds`` gauge.  Across machines
+the submit timestamp rides the job env (``CLOUD_TPU_SUBMIT_TS``, stamped
+into the deploy startup script) so the in-container first step measures
+true submit-to-first-step latency.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from cloud_tpu.monitoring import metrics
+
+#: Wall-clock unix seconds of job submission, stamped into the deployed
+#: container's env by ``core.deploy.startup_script`` so the remote first
+#: step can compute true submit-to-first-step latency.
+ENV_SUBMIT_TS = "CLOUD_TPU_SUBMIT_TS"
+
+#: Set to 1/true to enable the collector at import time (containers,
+#: benchmark children — anywhere nobody calls :func:`enable` by hand).
+ENV_TRACE = "CLOUD_TPU_TRACE"
+
+#: Gauge published once per process when a pending submit mark exists.
+SUBMIT_TO_FIRST_STEP_GAUGE = "run/submit_to_first_step_seconds"
+
+_DEFAULT_CAPACITY = 100_000
+
+
+class _NoopSpan:
+    """Shared do-nothing span: what :func:`span` returns while disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        return None
+
+
+_NOOP = _NoopSpan()
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class TimelineCollector:
+    """Bounded in-process buffer of finished spans + running aggregates.
+
+    The ring buffer bounds memory on long runs (oldest events drop); the
+    per-name aggregates are incremental and never dropped, so
+    :func:`aggregates` stays exact even after eviction.
+    """
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._evicted = 0
+        self._aggregates: Dict[str, dict] = {}
+        self._next_id = 1
+        # Chrome-trace ts is microseconds on an arbitrary epoch; anchor it
+        # so dumped timelines start near zero and stay monotonic.
+        self.epoch = time.perf_counter()
+
+    def next_span_id(self) -> int:
+        with self._lock:
+            span_id = self._next_id
+            self._next_id += 1
+            return span_id
+
+    def add(self, event: dict, duration_s: float) -> None:
+        with self._lock:
+            self._events.append(event)
+            if len(self._events) > self.capacity:
+                drop = len(self._events) - self.capacity
+                del self._events[:drop]
+                self._evicted += drop
+            agg = self._aggregates.setdefault(
+                event["name"],
+                {"count": 0, "total_seconds": 0.0, "max_seconds": 0.0},
+            )
+            agg["count"] += 1
+            agg["total_seconds"] += duration_s
+            if duration_s > agg["max_seconds"]:
+                agg["max_seconds"] = duration_s
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def aggregates(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                name: {
+                    **agg,
+                    "mean_seconds": agg["total_seconds"] / agg["count"],
+                }
+                for name, agg in self._aggregates.items()
+            }
+
+    @property
+    def evicted(self) -> int:
+        return self._evicted
+
+
+_collector: Optional[TimelineCollector] = None
+_collector_lock = threading.Lock()
+
+_submit_perf: Optional[float] = None
+_submit_consumed = False
+
+# Incremented/decremented by monitoring.profiler around jax.profiler
+# traces; nonzero => spans mirror themselves as TraceAnnotations.
+_xprof_depth = 0
+
+
+class Span:
+    """A live span: times itself, records on exit.  Not reentrant."""
+
+    __slots__ = (
+        "name", "attributes", "span_id", "parent_id",
+        "_collector", "_start", "_annotation",
+    )
+
+    def __init__(self, name: str, collector: TimelineCollector,
+                 attributes: Optional[Dict[str, Any]]):
+        self.name = name
+        self.attributes = attributes
+        self._collector = collector
+        self.span_id = collector.next_span_id()
+        self.parent_id = 0
+        self._start = 0.0
+        self._annotation = None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        if self.attributes is None:
+            self.attributes = {}
+        self.attributes[key] = value
+
+    def __enter__(self):
+        stack = _stack()
+        if stack:
+            self.parent_id = stack[-1].span_id
+        stack.append(self)
+        if _xprof_depth:
+            try:
+                import jax
+
+                self._annotation = jax.profiler.TraceAnnotation(self.name)
+                self._annotation.__enter__()
+            except Exception:  # noqa: BLE001 — tracing never kills the job
+                self._annotation = None
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        end = time.perf_counter()
+        if self._annotation is not None:
+            try:
+                self._annotation.__exit__(exc_type, exc, tb)
+            except Exception:  # noqa: BLE001 — tracing never kills the job
+                pass
+            self._annotation = None
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        else:  # pragma: no cover - misnested exit (generator finalization)
+            try:
+                stack.remove(self)
+            except ValueError:
+                pass
+        duration = end - self._start
+        collector = self._collector
+        args = {"span_id": self.span_id, "parent_id": self.parent_id}
+        if self.attributes:
+            args.update(self.attributes)
+        if exc_type is not None:
+            args["error"] = exc_type.__name__
+        collector.add(
+            {
+                "name": self.name,
+                "ph": "X",
+                "ts": (self._start - collector.epoch) * 1e6,
+                "dur": duration * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": args,
+            },
+            duration,
+        )
+        metrics.distribution_record(f"span/{self.name}", duration)
+        return False
+
+
+# --- lifecycle -----------------------------------------------------------
+
+
+def enabled() -> bool:
+    """Cheap predicate for call sites that compute span attributes."""
+    return _collector is not None
+
+
+def enable(capacity: int = _DEFAULT_CAPACITY) -> TimelineCollector:
+    """Install the process-wide collector (idempotent)."""
+    global _collector
+    with _collector_lock:
+        if _collector is None:
+            _collector = TimelineCollector(capacity)
+        return _collector
+
+
+def disable() -> None:
+    global _collector
+    with _collector_lock:
+        _collector = None
+
+
+def active() -> Optional[TimelineCollector]:
+    return _collector
+
+
+class collecting:
+    """Context manager: enable tracing for a block, restore after.
+
+    Returns the collector, so ``with tracing.collecting() as c:`` gives
+    direct access to ``c.events()`` / ``c.aggregates()``.
+    """
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._previous: Optional[TimelineCollector] = None
+
+    def __enter__(self) -> TimelineCollector:
+        global _collector
+        with _collector_lock:
+            self._previous = _collector
+            _collector = TimelineCollector(self.capacity)
+            return _collector
+
+    def __exit__(self, exc_type, exc, tb):
+        global _collector
+        with _collector_lock:
+            _collector = self._previous
+        return False
+
+
+def maybe_enable_from_env() -> bool:
+    """Env-gated enable, same contract as the exporter/profiler gates."""
+    if os.environ.get(ENV_TRACE, "").lower() in ("1", "true"):
+        enable()
+        return True
+    return False
+
+
+# --- the span API --------------------------------------------------------
+
+
+def span(name: str, **attributes: Any):
+    """Open a span: ``with tracing.span("step/compute"): ...``.
+
+    No-op (shared singleton, < 1 µs) when no collector is active.
+    Attributes land in the Chrome-trace ``args`` (payload bytes, step
+    numbers, trial ids ...).
+    """
+    collector = _collector
+    if collector is None:
+        return _NOOP
+    return Span(name, collector, attributes or None)
+
+
+def traced(fn=None, *, name: Optional[str] = None):
+    """Decorator form: ``@tracing.traced`` or ``@tracing.traced(name=...)``.
+
+    The span is named after the function (``module.qualname``) unless
+    ``name`` is given.  Disabled-mode overhead is one extra call frame.
+    """
+    if fn is None:
+        return functools.partial(traced, name=name)
+    span_name = name or f"{fn.__module__.split('.')[-1]}.{fn.__qualname__}"
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _collector is None:
+            return fn(*args, **kwargs)
+        with span(span_name):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def current_span() -> Optional[Span]:
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+# --- timeline export -----------------------------------------------------
+
+
+def timeline_events() -> List[dict]:
+    collector = _collector
+    return collector.events() if collector is not None else []
+
+
+def aggregates() -> Dict[str, dict]:
+    """Per-name ``{count, total_seconds, mean_seconds, max_seconds}``."""
+    collector = _collector
+    return collector.aggregates() if collector is not None else {}
+
+
+def dump_timeline(path: str) -> str:
+    """Write the collected spans as Chrome trace-event JSON.
+
+    Open the file in ``chrome://tracing`` or https://ui.perfetto.dev, or
+    summarize with ``python -m cloud_tpu.monitoring.report <path>``.
+    """
+    collector = _collector
+    events = collector.events() if collector is not None else []
+    meta = [
+        {
+            "ph": "M",
+            "pid": pid,
+            "tid": tid,
+            "name": "thread_name",
+            "args": {"name": _thread_name(tid)},
+        }
+        for pid, tid in sorted({(e["pid"], e["tid"]) for e in events})
+    ]
+    doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    if collector is not None and collector.evicted:
+        doc["otherData"] = {"evicted_events": collector.evicted}
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _thread_name(tid: int) -> str:
+    for thread in threading.enumerate():
+        if thread.ident == tid:
+            return thread.name
+    return f"thread-{tid}"
+
+
+# --- submit-to-first-step ------------------------------------------------
+
+
+def mark_submit() -> None:
+    """Record "a job was submitted now" (called by ``core.run.run()``).
+
+    Arms :func:`record_submit_to_first_step`; a later mark re-arms (a new
+    ``run()`` in the same process supersedes the old pending mark).
+    """
+    global _submit_perf, _submit_consumed
+    _submit_perf = time.perf_counter()
+    _submit_consumed = False
+
+
+def record_submit_to_first_step() -> Optional[float]:
+    """Publish ``run/submit_to_first_step_seconds`` once per submit mark.
+
+    Called by the trainer after the first completed train step.  The
+    elapsed time comes from (in priority order):
+
+    1. ``CLOUD_TPU_SUBMIT_TS`` — wall-clock submit stamp threaded through
+       the job env by deploy, the true cross-machine latency;
+    2. the in-process :func:`mark_submit` monotonic mark (local runs,
+       dry-run smoke tests).
+
+    Returns the recorded seconds, or None when nothing is pending.
+    """
+    global _submit_consumed
+    if _submit_consumed:
+        return None
+    elapsed: Optional[float] = None
+    env_ts = os.environ.get(ENV_SUBMIT_TS)
+    if env_ts:
+        try:
+            elapsed = max(0.0, time.time() - float(env_ts))
+        except ValueError:
+            elapsed = None
+    if elapsed is None and _submit_perf is not None:
+        elapsed = time.perf_counter() - _submit_perf
+    if elapsed is None:
+        return None
+    _submit_consumed = True
+    metrics.gauge_set(SUBMIT_TO_FIRST_STEP_GAUGE, elapsed)
+    collector = _collector
+    if collector is not None:
+        collector.add(
+            {
+                "name": SUBMIT_TO_FIRST_STEP_GAUGE,
+                "ph": "X",
+                "ts": (time.perf_counter() - collector.epoch) * 1e6
+                - elapsed * 1e6,
+                "dur": elapsed * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": {"span_id": collector.next_span_id(), "parent_id": 0},
+            },
+            elapsed,
+        )
+    return elapsed
+
+
+def clear_submit() -> None:
+    """Disarm a pending submit mark.
+
+    Called by ``run()`` when it raises before submitting: a failed run
+    must not leave a mark for a later, unrelated ``fit()`` in the same
+    process to consume as its submit-to-first-step origin.
+    """
+    global _submit_perf, _submit_consumed
+    _submit_perf = None
+    _submit_consumed = False
+
+
+def _reset_submit_state_for_tests() -> None:
+    clear_submit()
+
+
+# --- xprof mirroring (driven by monitoring.profiler) ---------------------
+
+
+def xprof_trace_started() -> None:
+    global _xprof_depth
+    _xprof_depth += 1
+
+
+def xprof_trace_stopped() -> None:
+    global _xprof_depth
+    _xprof_depth = max(0, _xprof_depth - 1)
+
+
+maybe_enable_from_env()

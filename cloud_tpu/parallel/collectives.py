@@ -25,23 +25,58 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from cloud_tpu.monitoring import tracing
+
 AxisNames = Union[str, Sequence[str]]
 
 
+def _payload_bytes(x):
+    """Stored bytes of a pytree (works on tracers: avals carry shape/dtype)."""
+    try:
+        return sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(x)
+            if hasattr(leaf, "size") and hasattr(leaf, "dtype")
+        )
+    except Exception:  # noqa: BLE001 — attribution only, never fail the op
+        return None
+
+
+def _span(name: str, x, axis):
+    """Collective span carrying payload size + axis.
+
+    These fire at TRACE time (collectives run inside jit), so they
+    attribute host-side tracing/lowering cost and record per-collective
+    payload sizes — the bytes the compiled program will move.  The
+    payload walk is skipped entirely when tracing is disabled.
+    """
+    if not tracing.enabled():
+        return tracing.span(name)
+    return tracing.span(
+        name, payload_bytes=_payload_bytes(x), axis=str(axis)
+    )
+
+
 def all_reduce_sum(x, axis: AxisNames):
-    return lax.psum(x, axis)
+    with _span("collective/all_reduce_sum", x, axis):
+        return lax.psum(x, axis)
 
 
 def all_reduce_mean(x, axis: AxisNames):
-    return lax.pmean(x, axis)
+    with _span("collective/all_reduce_mean", x, axis):
+        return lax.pmean(x, axis)
 
 
 def all_gather(x, axis: str, *, gather_dim: int = 0, tiled: bool = True):
-    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+    with _span("collective/all_gather", x, axis):
+        return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
 
 
 def reduce_scatter_sum(x, axis: str, *, scatter_dim: int = 0):
-    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+    with _span("collective/reduce_scatter_sum", x, axis):
+        return lax.psum_scatter(
+            x, axis, scatter_dimension=scatter_dim, tiled=True
+        )
 
 
 def ring_permute(x, axis: str, *, shift: int = 1):
@@ -51,9 +86,10 @@ def ring_permute(x, axis: str, *, shift: int = 1):
     which is what makes ring attention and pipeline transfers overlap with
     compute.
     """
-    n = lax.axis_size(axis)
-    perm = [(i, (i + shift) % n) for i in range(n)]
-    return lax.ppermute(x, axis, perm)
+    with _span("collective/ring_permute", x, axis):
+        n = lax.axis_size(axis)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return lax.ppermute(x, axis, perm)
 
 
 def axis_index(axis: str):
@@ -71,9 +107,10 @@ def barrier(axis: AxisNames):
 
 def broadcast_from(x, axis: str, *, root: int = 0):
     """Every member of ``axis`` gets root's value."""
-    idx = lax.axis_index(axis)
-    zero = jnp.where(idx == root, x, jnp.zeros_like(x))
-    return lax.psum(zero, axis)
+    with _span("collective/broadcast_from", x, axis):
+        idx = lax.axis_index(axis)
+        zero = jnp.where(idx == root, x, jnp.zeros_like(x))
+        return lax.psum(zero, axis)
 
 
 def host_local_mean(tree):
@@ -97,15 +134,18 @@ def hierarchical_all_reduce_sum(x, *, ici_axis: str, dcn_axis: str,
 
     ``scatter_dim`` must divide evenly by the ICI axis size.
     """
-    n = lax.axis_size(ici_axis)
-    if x.shape[scatter_dim] % n:
-        # Indivisible shapes can't scatter; correctness beats bandwidth.
-        return lax.psum(x, (ici_axis, dcn_axis))
-    shard = lax.psum_scatter(
-        x, ici_axis, scatter_dimension=scatter_dim, tiled=True
-    )
-    shard = lax.psum(shard, dcn_axis)
-    return lax.all_gather(shard, ici_axis, axis=scatter_dim, tiled=True)
+    with _span(
+        "collective/hierarchical_all_reduce_sum", x, (ici_axis, dcn_axis)
+    ):
+        n = lax.axis_size(ici_axis)
+        if x.shape[scatter_dim] % n:
+            # Indivisible shapes can't scatter; correctness beats bandwidth.
+            return lax.psum(x, (ici_axis, dcn_axis))
+        shard = lax.psum_scatter(
+            x, ici_axis, scatter_dimension=scatter_dim, tiled=True
+        )
+        shard = lax.psum(shard, dcn_axis)
+        return lax.all_gather(shard, ici_axis, axis=scatter_dim, tiled=True)
 
 
 def grad_sync(grads, axis: AxisNames, *, mean: bool = True,
@@ -129,7 +169,8 @@ def grad_sync(grads, axis: AxisNames, *, mean: bool = True,
             return reduce(g.astype(accum_dtype), axis).astype(dtype)
         return reduce(g, axis)
 
-    return jax.tree_util.tree_map(sync_leaf, grads)
+    with _span("collective/grad_sync", grads, axis):
+        return jax.tree_util.tree_map(sync_leaf, grads)
 
 
 def all_to_all_seq_heads(x, axis: str, *, seq_dim: int = 1,
@@ -155,6 +196,7 @@ def all_to_all_seq_heads(x, axis: str, *, seq_dim: int = 1,
             f"all_to_all split dim {split} (size {x.shape[split]}) must "
             f"divide by axis {axis!r} size {n}"
         )
-    return lax.all_to_all(
-        x, axis, split_axis=split, concat_axis=concat, tiled=True
-    )
+    with _span("collective/all_to_all_seq_heads", x, axis):
+        return lax.all_to_all(
+            x, axis, split_axis=split, concat_axis=concat, tiled=True
+        )

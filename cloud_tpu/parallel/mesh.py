@@ -193,7 +193,13 @@ def use_mesh(mesh: Mesh):
     prev = get_global_mesh()
     set_global_mesh(mesh)
     try:
-        with jax.set_mesh(mesh):
+        set_mesh = getattr(jax, "set_mesh", None) or getattr(
+            jax.sharding, "use_mesh", None
+        )
+        # Older jax (< 0.5) has neither entry point; the legacy
+        # ``with mesh:`` context is the only option there, and the
+        # custom_partitioning CHECK-failure above doesn't apply to it.
+        with (set_mesh(mesh) if set_mesh is not None else mesh):
             yield mesh
     finally:
         set_global_mesh(prev)

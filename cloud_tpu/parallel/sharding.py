@@ -97,7 +97,12 @@ def manual_context_mesh():
     *abstract* context mesh — a concrete Mesh there raises a mesh-mismatch
     error from XLA's sharding checks.
     """
-    am = jax.sharding.get_abstract_mesh()
+    get_abstract_mesh = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract_mesh is None:
+        # Older jax (< 0.5) has no abstract-mesh tracking (and no
+        # AxisType): there is no partial-manual context to detect.
+        return None
+    am = get_abstract_mesh()
     if am is not None and not am.empty and any(
         t == jax.sharding.AxisType.Manual for t in am.axis_types
     ):

@@ -13,6 +13,8 @@ import logging
 import os
 from typing import Any, Optional
 
+from cloud_tpu.monitoring import tracing
+
 logger = logging.getLogger(__name__)
 
 
@@ -44,7 +46,10 @@ class CheckpointManager:
     def save(self, step: int, state: Any) -> bool:
         import orbax.checkpoint as ocp
 
-        return self._manager.save(step, args=ocp.args.StandardSave(state))
+        # Async checkpointing: the span covers the blocking half (host
+        # gather + handoff), which is exactly the cost training pays.
+        with tracing.span("checkpoint/save", step=int(step)):
+            return self._manager.save(step, args=ocp.args.StandardSave(state))
 
     def restore(self, step: Optional[int] = None, *, template: Any = None):
         import orbax.checkpoint as ocp
@@ -52,11 +57,12 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"No checkpoints in {self._directory}")
-        if template is not None:
-            return self._manager.restore(
-                step, args=ocp.args.StandardRestore(template)
-            )
-        return self._manager.restore(step)
+        with tracing.span("checkpoint/restore", step=int(step)):
+            if template is not None:
+                return self._manager.restore(
+                    step, args=ocp.args.StandardRestore(template)
+                )
+            return self._manager.restore(step)
 
     def latest_step(self) -> Optional[int]:
         return self._manager.latest_step()

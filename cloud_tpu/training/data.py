@@ -12,6 +12,8 @@ from typing import Dict, Iterator, Optional
 
 import numpy as np
 
+from cloud_tpu.monitoring import tracing
+
 
 class ArrayDataset:
     """Re-iterable batched dataset over a dict of equal-length arrays.
@@ -42,13 +44,18 @@ class ArrayDataset:
             raise ValueError(f"batch_size {batch_size} > dataset size {self.n}")
 
     def __call__(self) -> Iterator[Dict[str, np.ndarray]]:
-        order = np.arange(self.n)
-        if self.shuffle:
-            self._rng.shuffle(order)
+        with tracing.span("data/epoch_setup", shuffle=self.shuffle, n=self.n):
+            order = np.arange(self.n)
+            if self.shuffle:
+                self._rng.shuffle(order)
         end = self.n - self.batch_size + 1 if self.drop_remainder else self.n
         for start in range(0, end, self.batch_size):
-            idx = order[start : start + self.batch_size]
-            yield {k: v[idx] for k, v in self.arrays.items()}
+            # Span covers the gather/copy only, not the consumer's time
+            # holding the generator suspended.
+            with tracing.span("data/batch"):
+                idx = order[start : start + self.batch_size]
+                batch = {k: v[idx] for k, v in self.arrays.items()}
+            yield batch
 
     def __len__(self) -> int:
         if self.drop_remainder:

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 import jax
 import numpy as np
 
+from cloud_tpu.monitoring import tracing
 from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules
 from cloud_tpu.training import train as train_lib
 
@@ -293,6 +294,11 @@ class Trainer:
         for cb in callbacks:
             cb.on_train_begin(self)
         step = int(self.state.step)
+        # The first DISPATCH of this fit() is where jit compilation happens
+        # (host-side, synchronous): span it separately so compile cost is
+        # attributable, and let a pending run() submit mark publish the
+        # run/submit_to_first_step_seconds composite gauge.
+        first_dispatch = True
         for epoch in range(epochs):
             if self.stop_training:
                 break
@@ -300,21 +306,36 @@ class Trainer:
                 cb.on_epoch_begin(epoch, self)
             epoch_metrics: Dict[str, List[float]] = {}
             epoch_start = time.perf_counter()
-            for i, batch in enumerate(train_data()):
-                if steps_per_epoch is not None and i >= steps_per_epoch:
+            data_iter = iter(train_data())
+            i = 0
+            while steps_per_epoch is None or i < steps_per_epoch:
+                with tracing.span("step/data"):
+                    batch = next(data_iter, None)
+                if batch is None:
                     break
-                batch = train_lib.shard_batch(batch, self.mesh, self.rules)
-                with self._mesh_context():
-                    self.state, metrics = self._train_step(self.state, batch)
+                compute_span = (
+                    "step/first_compile" if first_dispatch else "step/compute"
+                )
+                with tracing.span(compute_span):
+                    batch = train_lib.shard_batch(batch, self.mesh, self.rules)
+                    with self._mesh_context():
+                        self.state, metrics = self._train_step(
+                            self.state, batch
+                        )
+                if first_dispatch:
+                    first_dispatch = False
+                    tracing.record_submit_to_first_step()
                 step += 1
+                i += 1
                 # Metrics stay on device: forcing float() here would block
                 # async dispatch and serialize host and TPU every step.
                 # Callbacks get the device arrays and pay the sync only if
                 # they materialize them.
                 for key, value in metrics.items():
                     epoch_metrics.setdefault(key, []).append(value)
-                for cb in callbacks:
-                    cb.on_step_end(step, metrics, self)
+                with tracing.span("step/callbacks"):
+                    for cb in callbacks:
+                        cb.on_step_end(step, metrics, self)
                 if self.stop_training:
                     break
             epoch_host = jax.device_get(epoch_metrics)

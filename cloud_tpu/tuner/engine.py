@@ -15,6 +15,7 @@ import logging
 import random
 from typing import Any, Callable, Dict, List, Optional
 
+from cloud_tpu.monitoring import tracing
 from cloud_tpu.tuner.hyperparameters import HyperParameters
 
 logger = logging.getLogger(__name__)
@@ -157,6 +158,12 @@ class Tuner:
                 continue
 
     def run_trial(self, trial: Trial, **fit_kwargs) -> None:
+        with tracing.span(
+            "tuner/trial", trial_id=trial.trial_id, tuner_id=self.tuner_id
+        ):
+            self._run_trial(trial, **fit_kwargs)
+
+    def _run_trial(self, trial: Trial, **fit_kwargs) -> None:
         trainer = self.hypermodel(trial.hyperparameters)
         objective = self.oracle.objective
 

@@ -13,6 +13,7 @@ import datetime
 import logging
 from typing import Optional, Union
 
+from cloud_tpu.monitoring import tracing
 from cloud_tpu.tuner import vizier_utils
 from cloud_tpu.tuner.engine import Objective, Oracle, Trial, TrialStatus, Tuner
 from cloud_tpu.tuner.hyperparameters import HyperParameters
@@ -78,7 +79,11 @@ class CloudOracle(Oracle):
         # run up to N x max_trials trials between them.
         if len(self.service.list_trials()) >= self.max_trials:
             return None
-        suggestion = self.service.get_suggestion(client_id=tuner_id)
+        # Suggestion fetch is a remote round-trip (Vizier LRO with
+        # backoff): span it so tuner wall-clock attributes service wait
+        # separately from trial training time.
+        with tracing.span("tuner/suggest", tuner_id=tuner_id):
+            suggestion = self.service.get_suggestion(client_id=tuner_id)
         if suggestion is None:
             return None
         self._created += 1

@@ -1,0 +1,89 @@
+"""Version-compatibility shims for the jax API surface.
+
+The framework writes the modern jax spelling everywhere; when the
+installed jax predates an entry point (the tier-1 CPU rig pins 0.4.37),
+the moved symbol is backfilled onto the jax namespace at import time so
+call sites — and tests doing ``from jax import shard_map`` — work
+unconditionally.  Shims only ever fill a missing attribute; on a modern
+jax this module is a no-op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def install() -> None:
+    _install_shard_map()
+    _install_axis_size()
+    _install_typeof()
+    _install_pcast()
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    # jax.shard_map graduated from jax.experimental.shard_map with two
+    # kwargs renamed along the way; the wrapper translates the modern
+    # spelling (all our call sites use keywords):
+    #   check_vma=      -> check_rep=
+    #   axis_names={..} -> auto=frozenset(mesh.axis_names) - {..}
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except ImportError:  # pragma: no cover - very old jax: leave unset
+        return
+
+    @functools.wraps(_legacy)
+    def shard_map(f, *, mesh, in_specs, out_specs,
+                  check_vma=None, check_rep=None,
+                  axis_names=None, auto=None):
+        if check_rep is None:
+            check_rep = True if check_vma is None else check_vma
+        if auto is None:
+            auto = frozenset()
+            if axis_names is not None:
+                auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=check_rep, auto=auto)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size() -> None:
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        # On 0.4.x jax.core.axis_frame(name) resolves to the bound size.
+        if isinstance(axis_name, (tuple, list)):
+            size = 1
+            for name in axis_name:
+                size *= jax.core.axis_frame(name)
+            return size
+        return jax.core.axis_frame(axis_name)
+
+    jax.lax.axis_size = axis_size
+
+
+def _install_pcast() -> None:
+    if hasattr(jax.lax, "pcast"):
+        return
+
+    def pcast(x, axis_name=None, *, to=None):
+        # pcast moves values between vma states; pre-vma jax has no such
+        # state to track, so the cast is an identity on the data.
+        return x
+
+    jax.lax.pcast = pcast
+
+
+def _install_typeof() -> None:
+    if not hasattr(jax, "typeof"):
+        # jax.typeof returns the aval; pre-vma avals simply have no .vma
+        # attribute, which callers already treat as "empty set".
+        jax.typeof = jax.core.get_aval
+
+
+install()

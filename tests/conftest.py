@@ -24,19 +24,34 @@ if "--xla_force_host_platform_device_count" not in _flags:
 # Persistent compilation cache (VERDICT r3 weak #4: compile-heavy
 # shard_map tests dominate the ~21 min wall-clock).  Env vars, not
 # jax.config, so the rig's SUBPROCESS fleets (local_rig spawns real
-# ranks that inherit the environment) share the cache too.  Override the
-# location with CLOUD_TPU_TEST_CACHE_DIR (CI points it at a restored
-# actions/cache path); disable with CLOUD_TPU_TEST_CACHE_DIR=off.
-_cache_dir = os.environ.get("CLOUD_TPU_TEST_CACHE_DIR")
+# ranks that inherit the environment) share the cache too.
+#
+# OFF by default: jaxlib 0.4.37's CPU executable (de)serialization is
+# memory-unsafe for some Trainer step executables — loading a cached
+# jit_step written by a previous process SIGSEGVs, and merely *writing*
+# the save_and_load golden workload's executable corrupts the glibc heap
+# ("corrupted double-linked list" abort).  Either one kills the whole
+# pytest process mid-suite.  The compile-heavy shard_map tests the cache
+# was added for are `slow`-marked (excluded from tier-1), so the default
+# run loses little.  Opt back in with CLOUD_TPU_TEST_CACHE_DIR=<dir>
+# (e.g. CI on a jaxlib whose cache is sound); stale step-executable
+# entries are purged at session start even then, since those are the
+# known-crashy class.
+_cache_dir = os.environ.get("CLOUD_TPU_TEST_CACHE_DIR") or "off"
 if _cache_dir != "off":
-    _cache_dir = _cache_dir or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-    )
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
     # Cache everything: CPU test compiles are individually cheap but
     # collectively dominate; the default 1s threshold would skip most.
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+    import glob as _glob
+
+    for _stale in _glob.glob(os.path.join(_cache_dir, "jit_*step-*")):
+        try:
+            os.remove(_stale)
+        except OSError:
+            pass
 
 if "jax" in sys.modules:
     import jax

@@ -14,6 +14,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property-based fuzz suite needs hypothesis (not in the "
+    "minimal image); the example-based suites cover these paths",
+)
 from hypothesis import given, settings, strategies as st
 
 from cloud_tpu.models import quantization

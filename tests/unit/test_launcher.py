@@ -861,6 +861,11 @@ class TestBootstrap:
             bootstrap.main([f"--entry-point={entry}"])
         finally:
             monitoring_pkg.stop_exporter()
+            # bootstrap.main set the in-container guard directly in
+            # os.environ; monkeypatch never saw that write (the var was
+            # unset at test start), so drop it here or every later run()
+            # in the process takes the remote-guard early return.
+            os.environ.pop(bootstrap.ENV_RUNNING_REMOTELY, None)
 
         ts_posts = [
             (url, body) for method, url, body, _ in fake.calls

@@ -240,3 +240,43 @@ class TestReviewRegressions:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
         )
+
+
+class TestAdvisorHardening:
+    """ADVICE round 5: dequant orphan leaves + non-finite weights."""
+
+    def test_dequantize_orphan_q_leaf_passes_through(self):
+        # A leaf NAMED like a quantized product but missing its _scale
+        # sibling (hand-edited tree, or a genuine param ending in "_q")
+        # must survive dequantize_params untouched — not KeyError.
+        orphan = jnp.ones((4, 4), jnp.int8)
+        tree = {"layer": {"kernel_q": orphan, "bias": jnp.zeros((4,))}}
+        out = quantization.dequantize_params(tree)
+        assert set(out["layer"]) == {"kernel_q", "bias"}
+        assert out["layer"]["kernel_q"] is orphan
+
+    def test_dequantize_proper_pair_still_merges(self):
+        w = _w((128, 256))
+        q, scale = quantization.quantize_array(w, axis=-2)
+        out = quantization.dequantize_params(
+            {"kernel_q": q, "kernel_scale": scale}
+        )
+        assert set(out) == {"kernel"}
+        assert _rel_err(out["kernel"], w) < 0.05
+
+    def test_quantize_array_rejects_nan(self):
+        w = _w((64, 512)).at[3, 7].set(jnp.nan)
+        with pytest.raises(ValueError, match="non-finite"):
+            quantization.quantize_array(w, axis=-2)
+
+    def test_quantize_array_rejects_inf(self):
+        w = _w((64, 512)).at[0, 0].set(jnp.inf)
+        with pytest.raises(ValueError, match="non-finite"):
+            quantization.quantize_array(w, axis=-2)
+
+    def test_quantize_params_surfaces_corruption(self):
+        # The walker must not silently round-trip a corrupted eligible
+        # leaf as int8 noise.
+        bad = {"kernel": _w((128, 256)).at[0, 0].set(jnp.nan)}
+        with pytest.raises(ValueError, match="non-finite"):
+            quantization.quantize_params(bad)
