@@ -68,6 +68,14 @@ R224_BATCH = 128
 R224_WARMUP = 3
 R224_MEASURE = 10
 
+#: Fused multi-step context (train.make_multi_step): K steps per dispatch
+#: on the SAME CIFAR workload as the headline, so fused_steps_per_sec vs
+#: the headline isolates the host dispatch overhead the pipelined engine
+#: removes.  Small iter count: one window already runs K steps.
+FUSED_K = 4
+FUSED_WARMUP = 1
+FUSED_MEASURE = 5
+
 METRIC = f"resnet50_cifar10_b{BATCH_SIZE}_train_steps_per_sec_per_chip"
 
 #: The last DRIVER-VERIFIED number (BENCH_r02.json, 2026-07-29, TPU v5e-1,
@@ -276,6 +284,32 @@ def _measure_resnet224(extras):
         batch_size=R224_BATCH, warmup=R224_WARMUP, iters=R224_MEASURE,
     )
     extras["resnet224_steps_per_sec"] = round(steps_per_sec, 3)
+
+
+def _measure_fused(extras):
+    """K-step fused-dispatch throughput on the headline workload.
+
+    Context, not the regression number: the headline stays the 1-step
+    CIFAR ResNet so the perf trajectory remains comparable across rounds;
+    ``fused_steps_per_sec`` next to it shows what the pipelined execution
+    engine (multi-step dispatch) buys on this endpoint.
+    """
+    from cloud_tpu.utils.benchmarking import (
+        fused_throughput,
+        resnet_train_setup,
+    )
+
+    step, state, batch = resnet_train_setup(
+        imagenet_shape=False, batch_size=BATCH_SIZE,
+        steps_per_dispatch=FUSED_K,
+    )
+    compiled, _ = _compile_step(step, state, batch)
+    steps_per_sec = fused_throughput(
+        compiled, state, batch, steps_per_dispatch=FUSED_K,
+        warmup=FUSED_WARMUP, iters=FUSED_MEASURE,
+    )
+    extras["fused_steps_per_sec"] = round(steps_per_sec, 3)
+    extras["fused_steps_per_dispatch"] = FUSED_K
 
 
 def _bert_analytic_flops(cfg, batch_size, seq_len) -> float:
@@ -529,7 +563,11 @@ def _child_main() -> int:
                 )
 
     # Phase 3+: context.  Each must never sink the phases already printed.
+    # The fused measurement runs first: it reuses the headline's workload
+    # (cheapest compile delta) and is the number the pipelined-engine work
+    # is judged by, so a timeout later in the context forfeits it last.
     for fn, tag in (
+        (_measure_fused, "fused"),
         (_check_flash_attention, "flash_attention"),
         (_measure_bert, "bert"),
         (_measure_resnet224, "resnet224"),
@@ -678,7 +716,25 @@ def _emit(value: float, *, extras=None, error: str = "") -> None:
 
 def _push_error(errors, message):
     """Bounded error trail: a long probe loop must not accumulate an
-    unbounded list (the final join would materialize it all)."""
+    unbounded list (the final join would materialize it all).
+
+    Consecutive identical messages collapse into one ``msg (xN)`` entry —
+    rounds 3-5 recorded "probe: timed out after 75s" 13 times each, which
+    buried the one informative line in the BENCH json's error field.
+    """
+    if errors:
+        last = errors[-1]
+        if last == message:
+            errors[-1] = f"{message} (x2)"
+            return
+        if last.startswith(f"{message} (x") and last.endswith(")"):
+            try:
+                count = int(last[len(message) + 3:-1])
+            except ValueError:
+                count = None
+            if count is not None:
+                errors[-1] = f"{message} (x{count + 1})"
+                return
     if len(errors) < 40:
         errors.append(message)
     elif len(errors) == 40:

@@ -66,6 +66,7 @@ class MetricsCallback:
         self.prefix = prefix
         self._rate = WindowedRate(f"{prefix}/steps_per_sec", window)
         self._last_step_time = None
+        self._last_step_number = None
         self._lagged_logs = None
 
     def _record_lagged_loss(self):
@@ -83,6 +84,15 @@ class MetricsCallback:
         self._last_step_time = now
         self._rate.restart(now)
         self._lagged_logs = None
+        # Seed the step-delta base so the FIRST fused window counts all
+        # its steps (resumed fits start above zero).
+        self._last_step_number = None
+        state = getattr(trainer, "state", None)
+        if state is not None:
+            try:
+                self._last_step_number = int(state.step)
+            except (TypeError, ValueError):
+                pass
         counter_inc(f"{self.prefix}/runs")
 
     def on_train_end(self, trainer):
@@ -100,16 +110,24 @@ class MetricsCallback:
 
     def on_step_end(self, step, logs, trainer):
         now = _time.perf_counter()
+        # With fit(steps_per_dispatch=K) this hook fires once per fused
+        # K-step window; the step-number delta recovers K so train/steps
+        # and steps_per_sec stay per-STEP series, and step_time_ms stays
+        # per-step (window wall-clock / K).
+        n = 1
+        if self._last_step_number is not None:
+            n = max(1, step - self._last_step_number)
+        self._last_step_number = step
         if self._last_step_time is not None:
             distribution_record(
                 f"{self.prefix}/step_time_ms",
-                (now - self._last_step_time) * 1e3,
+                (now - self._last_step_time) * 1e3 / n,
             )
         self._last_step_time = now
-        counter_inc(f"{self.prefix}/steps")
+        counter_inc(f"{self.prefix}/steps", n)
         self._record_lagged_loss()
         self._lagged_logs = logs
-        self._rate.add(now)
+        self._rate.add(now, n)
 
     def on_epoch_end(self, epoch, logs, trainer):
         # Publish the partial window with the LAST step's timestamp, so

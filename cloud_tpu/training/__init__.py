@@ -11,10 +11,12 @@ from cloud_tpu.training.train import (
     TrainState,
     create_sharded_state,
     make_eval_step,
+    make_multi_step,
     make_train_step,
     param_shardings,
 )
-from cloud_tpu.training import optimizers
+from cloud_tpu.training import optimizers, pipeline_io
+from cloud_tpu.training.pipeline_io import prefetch_to_device
 from cloud_tpu.training.trainer import (
     Callback,
     EarlyStopping,
@@ -37,6 +39,9 @@ __all__ = [
     "ProgressLogger",
     "create_sharded_state",
     "make_train_step",
+    "make_multi_step",
     "make_eval_step",
     "param_shardings",
+    "pipeline_io",
+    "prefetch_to_device",
 ]

@@ -19,7 +19,8 @@ Three layers, each usable alone:
 * **Pipeline** — ``RecordDataset`` (per-host file shards via
   ``jax.process_index()``, shuffle buffer, batching; the zero-arg-callable
   contract ``Trainer.fit`` expects) and ``prefetch_to_device`` (background
-  thread overlapping host decode + transfer with device compute).
+  thread overlapping host decode + transfer with device compute — now
+  owned by ``training.pipeline_io``, re-exported here).
 
 Paths may be local (glob patterns supported) or ``gs://`` (listed and read
 via google.cloud.storage, injectable for tests).
@@ -30,7 +31,6 @@ from __future__ import annotations
 import glob as glob_lib
 import io
 import os
-import queue
 import struct
 import threading
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Union
@@ -766,111 +766,11 @@ def write_records(
     return paths
 
 
-class _PrefetchIterator:
-    """Drains a background thread that decodes + places batches on device.
-
-    Abandoning the iterator mid-epoch (``steps_per_epoch`` breaks out of
-    the for loop) must not leak the worker: ``close()`` — also wired to GC
-    via ``__del__`` — sets a stop flag the worker checks around its bounded
-    ``put``, so the thread exits and releases its open record file.
-    """
-
-    _DONE = object()
-
-    def __init__(self, source: Iterator, place: Callable, size: int):
-        self._queue: "queue.Queue" = queue.Queue(maxsize=size)
-        self._error: Optional[BaseException] = None
-        self._stop = threading.Event()
-
-        def put(item) -> bool:
-            while not self._stop.is_set():
-                try:
-                    self._queue.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def worker():
-            try:
-                for batch in source:
-                    if not put(place(batch)):
-                        return
-            except BaseException as exc:  # noqa: BLE001 — re-raised below
-                self._error = exc
-            finally:
-                close = getattr(source, "close", None)
-                if close is not None:
-                    close()
-                put(self._DONE)
-
-        self._thread = threading.Thread(target=worker, daemon=True)
-        self._thread.start()
-
-    def __iter__(self):
-        return self
-
-    def __next__(self):
-        item = self._queue.get()
-        if item is self._DONE:
-            self._thread.join()
-            if self._error is not None:
-                raise self._error
-            raise StopIteration
-        return item
-
-    def close(self) -> None:
-        self._stop.set()
-        # Unblock a worker stuck on a full queue, then let it finish.
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        self._thread.join(timeout=5.0)
-
-    def __del__(self):
-        if getattr(self, "_thread", None) is not None and self._thread.is_alive():
-            self.close()
-
-
-def prefetch_to_device(
-    dataset: Callable[[], Iterator],
-    *,
-    mesh=None,
-    rules=None,
-    size: int = 2,
-) -> Callable[[], Iterator]:
-    """Wrap a dataset so batches are transferred ahead of consumption.
-
-    A background thread runs host-side decode and ``shard_batch`` (device
-    transfer, mesh placement) up to ``size`` batches ahead — device compute
-    and host input processing overlap instead of alternating.  Returns the
-    same zero-arg-callable contract, so it drops into ``Trainer.fit``
-    (``shard_batch`` passes already-placed arrays through untouched).
-    """
-    from cloud_tpu.parallel.sharding import DEFAULT_RULES
-    from cloud_tpu.training import train as train_lib
-
-    rules = rules or DEFAULT_RULES
-
-    def place(batch):
-        if mesh is None:
-            # shard_batch is a no-op without a mesh; still transfer in the
-            # background so the overlap this function promises is real.
-            import jax
-
-            return jax.device_put(batch)
-        return train_lib.shard_batch(batch, mesh, rules)
-
-    def place_counted(batch):
-        from cloud_tpu.monitoring import metrics as _metrics
-
-        placed = place(batch)
-        _metrics.counter_inc("data/host_to_device_batches")
-        return placed
-
-    def factory():
-        return _PrefetchIterator(dataset(), place_counted, size)
-
-    return factory
+# The background prefetcher grew up here but serves every input pipeline
+# (in-memory arrays, validation, fused multi-step windows), so it now
+# lives in ``pipeline_io``; these aliases keep the long-standing import
+# path (``records.prefetch_to_device``) working.
+from cloud_tpu.training.pipeline_io import (  # noqa: E402,F401 — re-export
+    PrefetchIterator as _PrefetchIterator,
+    prefetch_to_device,
+)

@@ -109,7 +109,7 @@ def create_sharded_state(
         return jax.jit(build)(rng)
 
 
-def make_train_step(
+def _build_step_fn(
     loss_fn: Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]],
     optimizer: optax.GradientTransformation,
     *,
@@ -119,24 +119,11 @@ def make_train_step(
     stochastic: bool = False,
     accum_steps: int = 1,
 ):
-    """Build ``step(state, batch) -> (state, metrics)``, jit-compiled.
+    """The un-jitted ``step(state, batch) -> (state, metrics)`` body.
 
-    ``loss_fn(params, batch) -> (loss, metrics)``.  The returned step
-    donates the input state (in-place buffer reuse on TPU — halves HBM
-    traffic for the optimizer update).
-
-    ``stochastic=True`` threads the state's PRNG key through the loss:
-    ``loss_fn(params, batch, rng=...)`` gets a fresh split every step
-    (dropout et al.), and the state must have been created with a
-    ``train_rng`` (``create_sharded_state(..., train_rng=key)``).
-
-    ``accum_steps`` > 1 accumulates gradients over that many equal
-    micro-batches (batch dim 0 must divide) inside ONE optimizer update —
-    peak activation memory drops to one micro-batch's while the effective
-    batch stays whole.  For mean-reduced losses the accumulated gradient
-    equals the full-batch gradient exactly; scalar metrics are averaged
-    the same way.  The micro-batch loop is a ``lax.scan``, so the model
-    compiles once regardless of ``accum_steps``.
+    Shared by :func:`make_train_step` (one step per dispatch) and
+    :func:`make_multi_step` (K steps scanned inside one dispatch) so the
+    two paths cannot drift numerically.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -243,7 +230,107 @@ def make_train_step(
         metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
+    return step
+
+
+def make_train_step(
+    loss_fn: Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]],
+    optimizer: optax.GradientTransformation,
+    *,
+    logical_axes=None,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh: Optional[Mesh] = None,
+    stochastic: bool = False,
+    accum_steps: int = 1,
+):
+    """Build ``step(state, batch) -> (state, metrics)``, jit-compiled.
+
+    ``loss_fn(params, batch) -> (loss, metrics)``.  The returned step
+    donates the input state (in-place buffer reuse on TPU — halves HBM
+    traffic for the optimizer update).
+
+    ``stochastic=True`` threads the state's PRNG key through the loss:
+    ``loss_fn(params, batch, rng=...)`` gets a fresh split every step
+    (dropout et al.), and the state must have been created with a
+    ``train_rng`` (``create_sharded_state(..., train_rng=key)``).
+
+    ``accum_steps`` > 1 accumulates gradients over that many equal
+    micro-batches (batch dim 0 must divide) inside ONE optimizer update —
+    peak activation memory drops to one micro-batch's while the effective
+    batch stays whole.  For mean-reduced losses the accumulated gradient
+    equals the full-batch gradient exactly; scalar metrics are averaged
+    the same way.  The micro-batch loop is a ``lax.scan``, so the model
+    compiles once regardless of ``accum_steps``.
+    """
+    step = _build_step_fn(
+        loss_fn, optimizer, logical_axes=logical_axes, rules=rules,
+        mesh=mesh, stochastic=stochastic, accum_steps=accum_steps,
+    )
     return jax.jit(step, donate_argnums=0)
+
+
+def make_multi_step(
+    loss_fn: Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]],
+    optimizer: optax.GradientTransformation,
+    *,
+    steps_per_dispatch: int,
+    logical_axes=None,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh: Optional[Mesh] = None,
+    stochastic: bool = False,
+    accum_steps: int = 1,
+):
+    """Fuse ``steps_per_dispatch`` train steps into ONE jit dispatch.
+
+    ``multi_step(state, super_batch) -> (state, window_mean_metrics)``
+    where ``super_batch`` stacks K consecutive batches along a new leading
+    step axis (``pipeline_io.stack_batches``; place with
+    ``shard_batch(..., stacked=True)``).  The K optimizer updates run as a
+    ``lax.scan`` inside the compiled program, so the host pays ONE dispatch
+    (and Python callback fan-out) per K steps instead of per step — the
+    host-side overhead that dominates small-step workloads amortizes K-fold.
+
+    Semantics vs K sequential :func:`make_train_step` calls: the parameter
+    trajectory is identical (same step body, scanned); only the METRICS
+    cadence changes — the window's per-step metrics are averaged on device
+    (f32) and returned once per window, so per-step values are not
+    observable from the host.  The input state is donated, and the scan
+    carries it in place; per-step metrics never accumulate host-side.
+
+    The scan traces the step body once: compile cost does not grow with K,
+    and re-dispatching with the same shapes hits the jit cache (guarded by
+    tests/unit/test_pipeline_engine.py).
+    """
+    if steps_per_dispatch < 1:
+        raise ValueError(
+            f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}"
+        )
+    step = _build_step_fn(
+        loss_fn, optimizer, logical_axes=logical_axes, rules=rules,
+        mesh=mesh, stochastic=stochastic, accum_steps=accum_steps,
+    )
+
+    def multi_step(state: TrainState, super_batch) -> Tuple[TrainState, Dict]:
+        leaves = jax.tree_util.tree_leaves(super_batch)
+        if leaves and leaves[0].shape[0] != steps_per_dispatch:
+            raise ValueError(
+                f"super_batch leading axis {leaves[0].shape[0]} != "
+                f"steps_per_dispatch={steps_per_dispatch}"
+            )
+
+        def body(carry, batch):
+            new_state, metrics = step(carry, batch)
+            return new_state, {
+                k: v.astype(jnp.float32) for k, v in metrics.items()
+            }
+
+        state, stacked = jax.lax.scan(body, state, super_batch)
+        # Window means in f32, on device: the host sees K steps' worth of
+        # metrics as one small pytree, not K pinned buffers.
+        metrics = {k: jnp.mean(v, axis=0) for k, v in stacked.items()}
+        return state, metrics
+
+    return jax.jit(multi_step, donate_argnums=0)
 
 
 def make_eval_step(loss_fn: Callable[..., Tuple[jnp.ndarray, Dict]]):
@@ -323,7 +410,7 @@ def make_hybrid_dp_train_step(
 
 def shard_batch(batch, mesh: Optional[Mesh],
                 rules: ShardingRules = DEFAULT_RULES,
-                batch_axis: str = "batch"):
+                batch_axis: str = "batch", *, stacked: bool = False):
     """Place a batch pytree onto the mesh, sharded on dim 0.
 
     Single-process: ``batch`` is the global batch; a plain sharded
@@ -333,6 +420,10 @@ def shard_batch(batch, mesh: Optional[Mesh],
     ``make_array_from_process_local_data``.  The reference's analogue is
     MWMS auto-sharding the per-worker dataset; at pod scale the
     all-on-every-host alternative would OOM the hosts.
+
+    ``stacked=True`` places a multi-step super-batch (leading axis = steps
+    per dispatch, ``make_multi_step``): the step axis stays replicated and
+    the BATCH axis moves to dim 1.
     """
     if mesh is None:
         return batch
@@ -340,9 +431,10 @@ def shard_batch(batch, mesh: Optional[Mesh],
     import numpy as np
 
     multiprocess = jax.process_count() > 1
+    lead = [None, batch_axis] if stacked else [batch_axis]
 
     def place(x):
-        spec = rules.spec(*([batch_axis] + [None] * (x.ndim - 1)))
+        spec = rules.spec(*(lead + [None] * (x.ndim - len(lead))))
         sharding = NamedSharding(mesh, spec)
         if isinstance(x, jax.Array) and x.sharding == sharding:
             # Already placed (e.g. by records.prefetch_to_device's background

@@ -15,10 +15,12 @@ import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from cloud_tpu.monitoring import tracing
 from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules
+from cloud_tpu.training import pipeline_io
 from cloud_tpu.training import train as train_lib
 
 logger = logging.getLogger(__name__)
@@ -30,6 +32,11 @@ class Callback:
     ``on_step_end`` receives metrics as *device arrays* (materializing them
     with ``float()`` costs a host sync — do it sparingly); ``on_epoch_end``
     logs are already host floats.
+
+    Cadence: with ``fit(steps_per_dispatch=K)`` and K > 1, ``on_step_end``
+    fires once per fused K-step window — ``step`` is the global step at the
+    window's end and ``logs`` are the window's on-device metric means.
+    ``K=1`` (the default) keeps the exact per-step cadence.
     """
 
     def on_train_begin(self, trainer: "Trainer") -> None: ...
@@ -52,12 +59,39 @@ class History(Callback):
             self.history.setdefault(key, []).append(float(value))
 
 
-class ProgressLogger(Callback):
+class _StepBoundaryMixin:
+    """Shared cadence tracking for every-N-steps callbacks.
+
+    With ``fit(steps_per_dispatch=K)`` the ``on_step_end`` hook only sees
+    every K-th step number, so "every N steps" must mean "this window
+    CROSSED a multiple of N" — a plain ``step % N`` would fire only at
+    multiples of lcm(K, N).  For K=1 :meth:`_crossed` reduces to
+    ``step % N == 0`` exactly.
+    """
+
+    _prev_step: Optional[int] = None
+
+    def _seed_prev_step(self, trainer) -> None:
+        state = getattr(trainer, "state", None)
+        self._prev_step = int(state.step) if state is not None else None
+
+    def _crossed(self, step: int, every_n: int) -> bool:
+        prev = self._prev_step if self._prev_step is not None else step - 1
+        self._prev_step = step
+        return step // every_n > prev // every_n
+
+
+class ProgressLogger(_StepBoundaryMixin, Callback):
+    """Logs metrics every ``every_n_steps`` steps (window-aware)."""
+
     def __init__(self, every_n_steps: int = 50):
         self.every_n_steps = every_n_steps
 
+    def on_train_begin(self, trainer):
+        self._seed_prev_step(trainer)
+
     def on_step_end(self, step, logs, trainer):
-        if step % self.every_n_steps == 0:
+        if self._crossed(step, self.every_n_steps):
             rendered = " ".join(
                 f"{k}={float(v):.4f}" for k, v in sorted(logs.items())
             )
@@ -98,6 +132,10 @@ class EarlyStopping(Callback):
         self._best = -float("inf")
         self._wait = 0
         self._best_state = None
+        # Mirror on_train_begin: a restore path that reaches on_train_end
+        # without a completed on_train_begin (callback reused across fits,
+        # or unpickled mid-run) must not hit AttributeError.
+        self._best_shardings = None
         self.stopped_epoch: Optional[int] = None
 
     def on_train_begin(self, trainer):
@@ -155,7 +193,7 @@ class EarlyStopping(Callback):
                 )
 
 
-class TerminateOnNaN(Callback):
+class TerminateOnNaN(_StepBoundaryMixin, Callback):
     """Stop training the step a non-finite loss appears (Keras parity).
 
     Checks every step by default, like Keras — the cost is one host sync
@@ -173,9 +211,10 @@ class TerminateOnNaN(Callback):
 
     def on_train_begin(self, trainer):
         self.stopped_step = None
+        self._seed_prev_step(trainer)
 
     def on_step_end(self, step, logs, trainer):
-        if step % self.check_every_n_steps:
+        if not self._crossed(step, self.check_every_n_steps):
             return
         loss = logs.get("loss")
         if loss is None:
@@ -245,6 +284,7 @@ class Trainer:
         self.logical_axes = logical_axes
         self.rules = rules
         self.stochastic = stochastic
+        self.accum_steps = accum_steps
         self.state: Optional[train_lib.TrainState] = None
         self.stop_training = False
         self._train_step = train_lib.make_train_step(
@@ -252,6 +292,37 @@ class Trainer:
             mesh=mesh, stochastic=stochastic, accum_steps=accum_steps,
         )
         self._eval_step = train_lib.make_eval_step(loss_fn)
+        # Fused K-step dispatches, built lazily per K (jit caches compile
+        # per shape, so reusing the same callable across epochs/fits is
+        # what keeps the multi-step path one-compile).
+        self._multi_steps: Dict[int, Any] = {}
+
+    def _multi_step_for(self, steps_per_dispatch: int):
+        fn = self._multi_steps.get(steps_per_dispatch)
+        if fn is None:
+            fn = train_lib.make_multi_step(
+                self.loss_fn, self.optimizer,
+                steps_per_dispatch=steps_per_dispatch,
+                logical_axes=self.logical_axes, rules=self.rules,
+                mesh=self.mesh, stochastic=self.stochastic,
+                accum_steps=self.accum_steps,
+            )
+            self._multi_steps[steps_per_dispatch] = fn
+        return fn
+
+    @staticmethod
+    def _accumulate(sums: Dict[str, Any], metrics: Dict[str, Any],
+                    n_steps: int) -> None:
+        """Fold one step's (or one window's mean) metrics into running
+        on-device f32 sums — a few scalar adds per window instead of an
+        epoch-long list of pinned device buffers."""
+        for key, value in metrics.items():
+            contrib = value.astype(jnp.float32) if hasattr(
+                value, "astype") else jnp.float32(value)
+            if n_steps != 1:
+                contrib = contrib * n_steps
+            prev = sums.get(key)
+            sums[key] = contrib if prev is None else prev + contrib
 
     def init_state(self, rng) -> train_lib.TrainState:
         if self.init_fn is None:
@@ -275,12 +346,34 @@ class Trainer:
         validation_data: Optional[Callable[[], Iterable]] = None,
         callbacks: Optional[List[Callback]] = None,
         state: Optional[train_lib.TrainState] = None,
+        steps_per_dispatch: int = 1,
+        prefetch: int = 2,
     ) -> History:
         """Run the training loop.
 
         ``train_data``/``validation_data`` are zero-arg callables returning a
         fresh batch iterator per epoch (re-iterable datasets).
+
+        ``prefetch`` > 0 (default 2: double-buffering) runs host gather +
+        device transfer in a background thread that many batches ahead of
+        the device, for train AND validation data — pass 0 to keep the
+        fully synchronous loop.  Datasets already wrapped in
+        ``pipeline_io.prefetch_to_device`` are not wrapped twice.
+
+        ``steps_per_dispatch=K`` > 1 fuses K train steps into ONE jit
+        dispatch (``train.make_multi_step``): K consecutive batches are
+        stacked into a super-batch and scanned on device, so per-step host
+        overhead (dispatch, callback fan-out) amortizes K-fold.  The
+        parameter trajectory is unchanged; the observable cadence is:
+        ``on_step_end`` fires once per window with window-MEAN metrics
+        (TerminateOnNaN therefore detects a NaN up to K-1 steps late), and
+        a dataset tail shorter than K falls back to single-step dispatches.
+        ``K=1`` preserves exact per-step semantics.
         """
+        if steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}"
+            )
         if state is not None:
             self.state = state
         if self.state is None:
@@ -290,6 +383,34 @@ class Trainer:
         history = History()
         callbacks.append(history)
         self.stop_training = False
+
+        k = steps_per_dispatch
+        if k == 1:
+            source = train_data
+            if prefetch > 0 and not pipeline_io.is_prefetched(train_data):
+                source = pipeline_io.prefetch_to_device(
+                    train_data, mesh=self.mesh, rules=self.rules,
+                    size=prefetch, limit=steps_per_epoch,
+                )
+            multi_step = None
+        else:
+            if pipeline_io.is_prefetched(train_data):
+                raise ValueError(
+                    "steps_per_dispatch > 1 stacks HOST batches into a "
+                    "super-batch; pass the unwrapped dataset (fit prefetches "
+                    "whole windows itself)"
+                )
+            if prefetch > 0:
+                source = pipeline_io.prefetch_windows(
+                    train_data, k, mesh=self.mesh, rules=self.rules,
+                    size=prefetch, limit=steps_per_epoch,
+                )
+            else:
+                source = pipeline_io.iter_windows(
+                    train_data, k, mesh=self.mesh, rules=self.rules,
+                    limit=steps_per_epoch,
+                )
+            multi_step = self._multi_step_for(k)
 
         for cb in callbacks:
             cb.on_train_begin(self)
@@ -304,62 +425,142 @@ class Trainer:
                 break
             for cb in callbacks:
                 cb.on_epoch_begin(epoch, self)
-            epoch_metrics: Dict[str, List[float]] = {}
+            # Windowed on-device accumulation: running f32 sums instead of
+            # an epoch-long list of per-step device arrays, so step buffers
+            # stop being pinned for the whole epoch.
+            epoch_sums: Dict[str, Any] = {}
+            epoch_steps = 0
             epoch_start = time.perf_counter()
-            data_iter = iter(train_data())
-            i = 0
-            while steps_per_epoch is None or i < steps_per_epoch:
-                with tracing.span("step/data"):
-                    batch = next(data_iter, None)
-                if batch is None:
-                    break
-                compute_span = (
-                    "step/first_compile" if first_dispatch else "step/compute"
-                )
-                with tracing.span(compute_span):
-                    batch = train_lib.shard_batch(batch, self.mesh, self.rules)
-                    with self._mesh_context():
-                        self.state, metrics = self._train_step(
-                            self.state, batch
+            data_iter = iter(source())
+            try:
+                if k == 1:
+                    i = 0
+                    while steps_per_epoch is None or i < steps_per_epoch:
+                        with tracing.span("step/data"):
+                            batch = next(data_iter, None)
+                        if batch is None:
+                            break
+                        compute_span = (
+                            "step/first_compile" if first_dispatch
+                            else "step/compute"
                         )
-                if first_dispatch:
-                    first_dispatch = False
-                    tracing.record_submit_to_first_step()
-                step += 1
-                i += 1
-                # Metrics stay on device: forcing float() here would block
-                # async dispatch and serialize host and TPU every step.
-                # Callbacks get the device arrays and pay the sync only if
-                # they materialize them.
-                for key, value in metrics.items():
-                    epoch_metrics.setdefault(key, []).append(value)
-                with tracing.span("step/callbacks"):
-                    for cb in callbacks:
-                        cb.on_step_end(step, metrics, self)
-                if self.stop_training:
-                    break
-            epoch_host = jax.device_get(epoch_metrics)
-            logs = {k: float(np.mean(v)) for k, v in epoch_host.items()}
+                        with tracing.span(compute_span):
+                            batch = train_lib.shard_batch(
+                                batch, self.mesh, self.rules
+                            )
+                            with self._mesh_context():
+                                self.state, metrics = self._train_step(
+                                    self.state, batch
+                                )
+                        if first_dispatch:
+                            first_dispatch = False
+                            tracing.record_submit_to_first_step()
+                        step += 1
+                        i += 1
+                        # Metrics stay on device: forcing float() here would
+                        # block async dispatch and serialize host and TPU
+                        # every step.  Callbacks get the device arrays and
+                        # pay the sync only if they materialize them.
+                        self._accumulate(epoch_sums, metrics, 1)
+                        epoch_steps += 1
+                        with tracing.span("step/callbacks"):
+                            for cb in callbacks:
+                                cb.on_step_end(step, metrics, self)
+                        if self.stop_training:
+                            break
+                else:
+                    while True:
+                        with tracing.span("step/data"):
+                            item = next(data_iter, None)
+                        if item is None:
+                            break
+                        n, payload = item
+                        if n == k:
+                            compute_span = (
+                                "step/first_compile" if first_dispatch
+                                else "step/fused_compute"
+                            )
+                            with tracing.span(compute_span, steps=n):
+                                with self._mesh_context():
+                                    self.state, metrics = multi_step(
+                                        self.state, payload
+                                    )
+                        else:
+                            # Dataset tail shorter than K: single-step
+                            # dispatches, averaged so the callback cadence
+                            # stays one call per window.
+                            compute_span = (
+                                "step/first_compile" if first_dispatch
+                                else "step/compute"
+                            )
+                            with tracing.span(compute_span, steps=n):
+                                with self._mesh_context():
+                                    tail: Dict[str, Any] = {}
+                                    for batch in payload:
+                                        self.state, m = self._train_step(
+                                            self.state, batch
+                                        )
+                                        self._accumulate(tail, m, 1)
+                                    metrics = {
+                                        key: value / n
+                                        for key, value in tail.items()
+                                    }
+                        if first_dispatch:
+                            first_dispatch = False
+                            tracing.record_submit_to_first_step()
+                        step += n
+                        self._accumulate(epoch_sums, metrics, n)
+                        epoch_steps += n
+                        with tracing.span("step/callbacks"):
+                            for cb in callbacks:
+                                cb.on_step_end(step, metrics, self)
+                        if self.stop_training:
+                            break
+            finally:
+                # An abandoned prefetch iterator (steps_per_epoch break,
+                # stop_training, an exception) must join its worker thread
+                # rather than leak it; plain generators close the same way.
+                close = getattr(data_iter, "close", None)
+                if close is not None:
+                    close()
+            epoch_host = jax.device_get(epoch_sums)
+            logs = {
+                k_: float(np.mean(v) / max(epoch_steps, 1))
+                for k_, v in epoch_host.items()
+            }
             logs["epoch_seconds"] = time.perf_counter() - epoch_start
             if validation_data is not None:
-                val = self.evaluate(validation_data)
-                logs.update({f"val_{k}": v for k, v in val.items()})
+                val = self.evaluate(validation_data, prefetch=prefetch)
+                logs.update({f"val_{k_}": v for k_, v in val.items()})
             for cb in callbacks:
                 cb.on_epoch_end(epoch, logs, self)
         for cb in callbacks:
             cb.on_train_end(self)
         return history
 
-    def evaluate(self, data: Callable[[], Iterable]) -> Dict[str, float]:
-        metrics_acc: Dict[str, list] = {}
-        for batch in data():
-            batch = train_lib.shard_batch(batch, self.mesh, self.rules)
-            with self._mesh_context():
-                metrics = self._eval_step(self.state, batch)
-            for key, value in metrics.items():
-                metrics_acc.setdefault(key, []).append(value)
-        host = jax.device_get(metrics_acc)
-        return {k: float(np.mean(v)) for k, v in host.items()}
+    def evaluate(self, data: Callable[[], Iterable], *,
+                 prefetch: int = 2) -> Dict[str, float]:
+        source = data
+        if prefetch > 0 and not pipeline_io.is_prefetched(data):
+            source = pipeline_io.prefetch_to_device(
+                data, mesh=self.mesh, rules=self.rules, size=prefetch
+            )
+        sums: Dict[str, Any] = {}
+        count = 0
+        data_iter = iter(source())
+        try:
+            for batch in data_iter:
+                batch = train_lib.shard_batch(batch, self.mesh, self.rules)
+                with self._mesh_context():
+                    metrics = self._eval_step(self.state, batch)
+                self._accumulate(sums, metrics, 1)
+                count += 1
+        finally:
+            close = getattr(data_iter, "close", None)
+            if close is not None:
+                close()
+        host = jax.device_get(sums)
+        return {k: float(np.mean(v) / max(count, 1)) for k, v in host.items()}
 
     def _mesh_context(self):
         import contextlib

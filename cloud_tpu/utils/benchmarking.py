@@ -87,7 +87,8 @@ def decode_tokens_per_sec(params, cfg, prompts, lens, *, max_new_tokens,
     return iters * prompts.shape[0] * max_new_tokens / elapsed
 
 
-def resnet_train_setup(*, imagenet_shape: bool, batch_size: int):
+def resnet_train_setup(*, imagenet_shape: bool, batch_size: int,
+                       steps_per_dispatch: int = 1):
     """The ResNet benchmark workload, built ONCE for every measurer.
 
     ``bench.py`` (the driver artifact) and ``scripts/measure_baselines.py``
@@ -96,6 +97,11 @@ def resnet_train_setup(*, imagenet_shape: bool, batch_size: int):
     optimizer, and synthetic batch in lockstep.  Returns
     ``(step, state, batch)`` with the step un-compiled (bench.py AOT
     lowers it for cost analysis; other callers may call it directly).
+
+    ``steps_per_dispatch`` > 1 returns the FUSED variant instead —
+    ``train.make_multi_step`` plus a K-stacked super-batch of distinct
+    synthetic batches — so the fused context number times the same model,
+    optimizer, and per-step batch shape as the headline.
     """
     import functools
 
@@ -117,14 +123,36 @@ def resnet_train_setup(*, imagenet_shape: bool, batch_size: int):
         tx,
         mesh=None,
     )
-    step = train_lib.make_train_step(
-        functools.partial(resnet.loss_fn, config=config), tx
-    )
+    loss = functools.partial(resnet.loss_fn, config=config)
     rng = np.random.default_rng(0)
+    shape = (batch_size, image_hw, image_hw, 3)
+    if steps_per_dispatch > 1:
+        shape = (steps_per_dispatch,) + shape
+        step = train_lib.make_multi_step(
+            loss, tx, steps_per_dispatch=steps_per_dispatch
+        )
+        label = rng.integers(
+            0, num_classes, (steps_per_dispatch, batch_size)
+        )
+    else:
+        step = train_lib.make_train_step(loss, tx)
+        label = rng.integers(0, num_classes, batch_size)
     batch = jax.device_put({
-        "image": rng.normal(
-            size=(batch_size, image_hw, image_hw, 3)
-        ).astype(np.float32),
-        "label": rng.integers(0, num_classes, batch_size),
+        "image": rng.normal(size=shape).astype(np.float32),
+        "label": label,
     })
     return step, state, batch
+
+
+def fused_throughput(multi_step, state, super_batch, *, steps_per_dispatch,
+                     warmup=1, iters=5):
+    """Steps/sec (STEPS, not windows) of a K-fused multi-step dispatch.
+
+    Delegates to :func:`chain_then_read_throughput` — a multi-step window
+    has the same ``(state, batch) -> (state, metrics)`` shape, so the
+    load-bearing timing contract stays in ONE place — and scales the
+    windows/sec result by K.
+    """
+    return steps_per_dispatch * chain_then_read_throughput(
+        multi_step, state, super_batch, warmup=warmup, iters=iters
+    )

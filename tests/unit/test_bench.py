@@ -125,7 +125,9 @@ def test_probe_retries_instead_of_burning_attempts(bench, monkeypatch, capsys):
     record = _emitted(capsys)
     assert record["value"] == 171.4
     assert state["probes"] == 3
-    assert record["error"].count("probe:") == 2
+    # Two identical probe timeouts collapse into one "(x2)" trail entry.
+    assert record["error"].count("probe:") == 1
+    assert "(x2)" in record["error"]
 
 
 def test_gn_kernel_disabled_after_headline_less_timeout(bench, monkeypatch,
@@ -243,6 +245,87 @@ def test_suspect_headline_retried_with_kernel_off(bench, monkeypatch, capsys):
     assert record["value"] == 148.0
     assert envs[1]["CLOUD_TPU_GN_KERNEL"] == "0"
     assert "divergent GN kernel" in record["error"]
+
+
+def test_push_error_collapses_consecutive_repeats(bench):
+    """Rounds 3-5 recorded 'probe: timed out after 75s' 13x each; the
+    trail must collapse consecutive repeats into one '(xN)' entry."""
+    errors = []
+    for _ in range(13):
+        bench._push_error(errors, "probe: timed out after 75s")
+    assert errors == ["probe: timed out after 75s (x13)"]
+    # A different message breaks the run; the next repeat starts fresh.
+    bench._push_error(errors, "attempt 1: no headline")
+    bench._push_error(errors, "probe: timed out after 75s")
+    bench._push_error(errors, "probe: timed out after 75s")
+    assert errors == [
+        "probe: timed out after 75s (x13)",
+        "attempt 1: no headline",
+        "probe: timed out after 75s (x2)",
+    ]
+
+
+def test_push_error_collapse_keeps_trail_bounded(bench):
+    """Collapsing composes with the 40-entry bound: 100 distinct messages
+    with repeats interleaved stay <= 41 entries."""
+    errors = []
+    for i in range(100):
+        bench._push_error(errors, f"error {i}")
+        bench._push_error(errors, f"error {i}")
+    assert len(errors) == 41
+    assert errors[0] == "error 0 (x2)"
+    assert errors[-1] == "... further errors suppressed"
+
+
+def test_probe_loop_error_trail_collapsed_end_to_end(bench, monkeypatch,
+                                                    capsys):
+    """The real probe loop produces the collapsed form in the BENCH json."""
+    monkeypatch.setattr(bench, "TOTAL_BUDGET_S", 2.0)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT_S", 0.5)
+
+    def fake_run(argv, *, timeout, **kwargs):
+        raise subprocess.TimeoutExpired(argv, timeout)
+
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
+    assert bench.main() == 1
+    record = _emitted(capsys)
+    # One collapsed probe entry, not N identical clauses.
+    assert record["error"].count("probe: timed out") == 1
+    assert "(x" in record["error"]
+
+
+def test_fused_context_field_rides_the_headline(bench, monkeypatch, capsys):
+    """The fused phase's fused_steps_per_sec lands in the final record
+    next to the unchanged headline metric."""
+
+    def fake_run(argv, **kwargs):
+        if "--probe" in argv:
+            return _proc(_lines(PROBE_OK))
+        return _proc(_lines(
+            RESNET_OK,
+            {"phase": "fused", "ok": True,
+             "extras": {"fused_steps_per_sec": 612.5,
+                        "fused_steps_per_dispatch": 4}},
+        ))
+
+    monkeypatch.setattr(bench, "_hardened_run", fake_run)
+    assert bench.main() == 0
+    record = _emitted(capsys)
+    assert record["value"] == 171.4  # headline untouched
+    assert record["fused_steps_per_sec"] == 612.5
+    assert record["fused_steps_per_dispatch"] == 4
+
+
+def test_child_measures_fused_phase():
+    """Static check: the fused context phase is wired into the child's
+    phase list (after the headline, so a hang forfeits it, not the
+    number of record)."""
+    src = open(os.path.join(REPO, "bench.py")).read()
+    child = src[src.index("def _child_main"):]
+    assert "_measure_fused" in child
+    assert child.index("_measure_resnet(extras)") < child.index(
+        "_measure_fused"
+    )
 
 
 def test_child_runs_headline_before_gates():

@@ -893,6 +893,24 @@ class TestEarlyStopping:
         cb.on_epoch_end(0, {"loss": 1.0}, trainer)
         assert not trainer.stop_training
 
+    def test_best_shardings_initialized_in_init(self):
+        """Restore paths must not depend on on_train_begin having run:
+        a callback restored/reused with a host-side _best_state reaches
+        on_train_end's device_put branch, which reads _best_shardings —
+        previously only set in on_train_begin (AttributeError)."""
+        from cloud_tpu.training import EarlyStopping
+
+        cb = EarlyStopping("loss", restore_best_state=True)
+        assert cb._best_shardings is None
+        # Simulate a cross-process restore: host-array best state present,
+        # on_train_begin never called in this process.
+        cb._best_state = {"w": np.ones((2, 2), np.float32)}
+        trainer = self._FakeTrainer()
+        cb.on_train_end(trainer)  # must not raise AttributeError
+        np.testing.assert_array_equal(
+            np.asarray(trainer.state["w"]), np.ones((2, 2), np.float32)
+        )
+
     def test_restore_best_state_preserves_values_and_shardings(self):
         from cloud_tpu.training import EarlyStopping
 

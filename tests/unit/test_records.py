@@ -231,6 +231,40 @@ class TestPrefetch:
             got = [np.asarray(b["x"])[:, 0].tolist() for b in prefetched()]
             assert got == direct
 
+    def test_abandoned_iterator_joins_worker_thread(self, tmp_path):
+        """Abandoning the iterator mid-epoch (steps_per_epoch break) must
+        join the background thread and release the queue — no thread leak
+        across tests (ISSUE 2 satellite; asserted via
+        ``threading.enumerate()``)."""
+        import gc
+        import threading
+
+        from cloud_tpu.training import pipeline_io
+
+        def workers():
+            return [
+                t for t in threading.enumerate()
+                if t.name == pipeline_io.PREFETCH_THREAD_NAME and t.is_alive()
+            ]
+
+        write_range_files(tmp_path, num_files=4, per_file=32)
+        ds = records.RecordDataset(
+            str(tmp_path / "*.rec"), batch_size=2, shard_by_process=False
+        )
+        # Explicit close.
+        it = records.prefetch_to_device(ds, size=1)()
+        next(it)
+        assert workers()
+        it.close()
+        assert not workers()
+        # GC of an abandoned iterator must join too (the worker must not
+        # hold a reference that keeps the iterator immortal).
+        it = records.prefetch_to_device(ds, size=1)()
+        next(it)
+        del it
+        gc.collect()
+        assert not workers()
+
     def test_prefetch_propagates_errors(self):
         def bad_dataset():
             yield {"x": np.zeros(1)}
