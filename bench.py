@@ -203,6 +203,18 @@ def _probe_main() -> int:
     for _ in range(3):  # chained — a hung tunnel cannot satisfy the read
         y = y @ x
     checksum = float(y.astype(jnp.float32).sum())
+    # Cache-miss vs cache-hit timing of one jitted matmul: the bench-side
+    # proxy for submit-to-first-step (cold_compile ~ what a fresh process
+    # pays before its first dispatch; warm_dispatch ~ with a ready
+    # executable, i.e. what compile-ahead / the persistent cache buy).
+    # Always measured, even when the full attempt later times out.
+    probed = jax.jit(lambda a: a @ a)
+    t0 = time.perf_counter()
+    probed(x).block_until_ready()
+    cold_compile = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    probed(x).block_until_ready()
+    warm_dispatch = time.perf_counter() - t0
     _emit_phase(
         "probe",
         ok=True,
@@ -210,6 +222,8 @@ def _probe_main() -> int:
         device_kind=getattr(devices[0], "device_kind", "?"),
         backend=jax.default_backend(),
         checksum=checksum,
+        cold_compile_seconds=round(cold_compile, 4),
+        warm_dispatch_seconds=round(warm_dispatch, 6),
     )
     return 0
 
@@ -863,6 +877,9 @@ def _main_locked() -> int:
             continue
         merged.setdefault("device_kind", probe.get("device_kind"))
         merged.setdefault("n_devices", probe.get("n_devices"))
+        for key in ("cold_compile_seconds", "warm_dispatch_seconds"):
+            if probe.get(key) is not None:
+                merged.setdefault(key, probe[key])
 
         # Step 2: one measurement attempt.  After a headline-less timeout
         # or a suspect (divergent-GN, uncorrected) headline, disable the

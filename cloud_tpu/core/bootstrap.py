@@ -69,6 +69,17 @@ def main(argv=None) -> None:
         logger.exception("metrics exporter failed to start")
     monitoring.profiler.maybe_start_server_from_env()
 
+    # Env-gated persistent compile cache (CLOUD_TPU_COMPILE_CACHE, forwarded
+    # by deploy's startup script): probe + enable BEFORE the user script
+    # compiles anything, so a preemption-restarted container warm-starts
+    # its step executables from disk instead of recompiling from scratch.
+    try:
+        from cloud_tpu.training import compile_cache
+
+        compile_cache.maybe_enable_persistent_cache()
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        logger.exception("persistent compile cache setup failed; continuing")
+
     entry_point = args.entry_point
     if entry_point.endswith(".ipynb"):
         from cloud_tpu.core import notebook

@@ -12,6 +12,7 @@ with the ``jax.distributed`` env contract filled in — replacing both CAIP's
 from __future__ import annotations
 
 import logging
+import os
 import time
 import uuid
 from typing import Callable, Dict, List, Optional
@@ -51,6 +52,7 @@ def startup_script(
     monitoring: bool = True,
     profiler_port: Optional[int] = None,
     submit_ts: Optional[float] = None,
+    compile_cache: Optional[str] = None,
 ) -> str:
     """TPU-VM startup script: pull + run the training container on each host.
 
@@ -73,7 +75,14 @@ def startup_script(
     into the container as CLOUD_TPU_SUBMIT_TS so the remote trainer's
     first completed step can publish the true end-to-end
     ``run/submit_to_first_step_seconds`` gauge (monitoring.tracing).
+    ``compile_cache`` (default: the submitting process's
+    ``CLOUD_TPU_COMPILE_CACHE``) forwards the persistent-compile-cache
+    directory into the container — a container-local path, where the
+    bootstrap's safety probe decides whether to actually enable it
+    (training.compile_cache); pass ``""`` to suppress forwarding.
     """
+    if compile_cache is None:
+        compile_cache = os.environ.get("CLOUD_TPU_COMPILE_CACHE", "")
     lines = [
         "#! /bin/bash",
         "set -ex",
@@ -103,6 +112,17 @@ def startup_script(
         lines.append(f"  -e CLOUD_TPU_PROFILER_PORT={int(profiler_port)} \\")
     if submit_ts is not None:
         lines.append(f"  -e CLOUD_TPU_SUBMIT_TS={submit_ts!r} \\")
+    if compile_cache:
+        import shlex
+
+        # First arbitrary user-environment string baked into this root
+        # startup script: quote it, or a space truncates the docker line
+        # and shell metacharacters execute on the TPU VM.
+        lines.append(
+            "  -e "
+            + shlex.quote(f"CLOUD_TPU_COMPILE_CACHE={compile_cache}")
+            + " \\"
+        )
     lines.append(f"  {image_uri}")
     return "\n".join(lines)
 
@@ -119,6 +139,7 @@ def build_node_request(
     monitoring: bool = True,
     profiler_port: Optional[int] = None,
     submit_ts: Optional[float] = None,
+    compile_cache: Optional[str] = None,
 ) -> dict:
     """The TPU v2 API Node body for one slice (golden-tested)."""
     topo = config.tpu_topology()
@@ -134,6 +155,7 @@ def build_node_request(
                 monitoring=monitoring,
                 profiler_port=profiler_port,
                 submit_ts=submit_ts,
+                compile_cache=compile_cache,
             )
         },
         "labels": dict(job_labels or {}),
@@ -155,6 +177,7 @@ def build_job_request(
     monitoring: bool = True,
     profiler_port: Optional[int] = None,
     submit_ts: Optional[float] = None,
+    compile_cache: Optional[str] = None,
 ) -> dict:
     """All node bodies for a (multi-)slice job, keyed by node id.
 
@@ -179,6 +202,7 @@ def build_job_request(
             monitoring=monitoring,
             profiler_port=profiler_port,
             submit_ts=submit_ts,
+            compile_cache=compile_cache,
         )
     return {"job_id": job_id, "nodes": nodes}
 

@@ -446,6 +446,14 @@ def loss_fn(
     if config.fused_ce:
         hidden, aux = apply_hidden(params, tokens, config, rules=rules,
                                    mesh=mesh)
+        # Pin the hidden states' layout before the chunked-CE scan:
+        # without the constraint GSPMD is free to guess a layout for the
+        # chunk intermediates (the [B, T, V] logits never materialize to
+        # anchor one), and a bad guess inserts resharding inside the
+        # vocab-chunk loop.  Mirrors the constraint `apply` puts on its
+        # full logits (ADVICE round 5).
+        hidden = shard_constraint(hidden, "batch", "seq", "act_embed",
+                                  rules=rules, mesh=mesh)
         logits = None
     else:
         logits, aux = apply(params, tokens, config, rules=rules, mesh=mesh)

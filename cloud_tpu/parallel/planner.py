@@ -146,8 +146,10 @@ def plan_mesh(
         num_slices = worker_count + 1
         if num_devices % num_slices:
             raise ValueError(
-                f"num_devices={num_devices} not divisible into "
-                f"{num_slices} slices"
+                f"num_devices={num_devices} cannot be split into "
+                f"worker_count + 1 = {num_slices} equal virtual slices "
+                f"(worker_count={worker_count}); num_devices must be a "
+                f"multiple of {num_slices}"
             )
         chips_per_slice = num_devices // num_slices
         hosts_per_slice = 1

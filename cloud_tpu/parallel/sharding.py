@@ -110,6 +110,69 @@ def manual_context_mesh():
     return None
 
 
+def pad_batch(batch, pad_to: int, *, axis: int = 0):
+    """Zero-pad every leaf of a HOST batch along ``axis``; returns
+    ``(padded, valid)``.
+
+    ``valid`` is a float32 ``[pad_to]`` mask with 1.0 marking real rows —
+    the per-example (or, for a stacked super-batch, per-step) validity
+    that the masked train-step paths consume.  Padding with zeros keeps
+    every leaf's dtype and the downstream compiled shapes fixed, so a
+    short tail reuses an already-compiled executable instead of tracing
+    a fresh one (`training.train.make_multi_step`'s ``valid`` argument
+    skips the padded slots entirely, so garbage-in never reaches the
+    optimizer).
+
+    Host-side by design: the windowing pipelines pad BEFORE placement
+    (device arrays passed here are pulled back to host first).
+
+    Leaves without the padded axis (scalars, lower-rank side data) pass
+    through untouched; leaves that HAVE the axis must agree on its length
+    — disagreement is ambiguous (which one defines "the batch"?) and
+    raises instead of silently padding to inconsistent sizes.
+    """
+    if pad_to < 1:
+        raise ValueError(f"pad_to must be >= 1, got {pad_to}")
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(batch)
+    lengths = {
+        int(np.shape(leaf)[axis])
+        for leaf in leaves
+        if len(np.shape(leaf)) > axis
+    }
+    if not lengths:
+        raise ValueError(
+            f"pad_batch: no leaf has axis {axis} to pad (leaf shapes: "
+            f"{[np.shape(leaf) for leaf in leaves]})"
+        )
+    if len(lengths) > 1:
+        raise ValueError(
+            f"pad_batch: leaves disagree on axis {axis} length "
+            f"({sorted(lengths)}); a consistent batch axis is required "
+            "to pad unambiguously"
+        )
+    n = lengths.pop()
+    if n > pad_to:
+        raise ValueError(
+            f"batch axis {axis} has {n} rows, more than pad_to={pad_to}"
+        )
+    valid = np.zeros((pad_to,), np.float32)
+    valid[:n] = 1.0
+    if n == pad_to:
+        return batch, valid
+
+    def pad(x):
+        x = np.asarray(x)
+        if x.ndim <= axis:
+            return x  # no batch axis: side data rides along unpadded
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad_to - n)
+        return np.pad(x, widths)
+
+    return jax.tree_util.tree_map(pad, batch), valid
+
+
 def shard_constraint(
     x,
     *logical_axes: Optional[str],

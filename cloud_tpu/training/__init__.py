@@ -15,7 +15,7 @@ from cloud_tpu.training.train import (
     make_train_step,
     param_shardings,
 )
-from cloud_tpu.training import optimizers, pipeline_io
+from cloud_tpu.training import compile_cache, optimizers, pipeline_io
 from cloud_tpu.training.pipeline_io import prefetch_to_device
 from cloud_tpu.training.trainer import (
     Callback,
@@ -42,6 +42,7 @@ __all__ = [
     "make_multi_step",
     "make_eval_step",
     "param_shardings",
+    "compile_cache",
     "pipeline_io",
     "prefetch_to_device",
 ]

@@ -1,0 +1,558 @@
+"""Compile-ahead engine: AOT step compilation and a safe persistent cache.
+
+XLA compilation dominates small-job submit-to-first-step latency (the
+north star's second headline metric): the trainer's first dispatch pays
+lower + backend-compile synchronously while the device sits idle, and a
+fresh process pays it all again.  This module makes that cost an
+engineered quantity instead of an accident, three ways:
+
+* **AOT registry** — :func:`get_or_compile` keys
+  ``jax.jit(step).lower(abstract_avals).compile()`` artifacts by
+  (step-fn identity, abstract input avals, mesh + sharding rules,
+  donation signature, steps-per-dispatch), so a second fit over the same
+  shapes reuses the executable without touching jit's dispatch path.
+  Every compile is spanned as ``compile/lower`` and
+  ``compile/backend_compile`` (monitoring.tracing), so the report CLI
+  attributes cold-start wall-clock phase by phase.
+* **Background compile-ahead** — :func:`start_compile_ahead` compiles
+  the fit's step executables on a worker thread *while*
+  ``pipeline_io`` prefetch warms, and hands the trainer
+  :class:`AotStep` wrappers that dispatch through the ready executable
+  (falling back to the plain jitted function on any input mismatch —
+  compile-ahead can make a fit faster, never wrong).
+* **Safe persistent cache** — :func:`maybe_enable_persistent_cache`
+  re-enables jax's on-disk compilation cache behind
+  ``CLOUD_TPU_COMPILE_CACHE=<dir>``, gated on a one-time child-process
+  round-trip probe (compile a trainer-shaped jitted step, drop the
+  in-memory caches, recompile from disk, execute, compare).  jaxlib
+  0.4.36/0.4.37 executable (de)serialization corrupts the glibc heap
+  for some step executables (the reason PR 1 disabled the cache
+  outright); the probe quarantines that class in a child that can die
+  harmlessly, and a version blocklist refuses the known-bad jaxlibs up
+  front unless ``CLOUD_TPU_COMPILE_CACHE_FORCE=1``.  Newer jaxlibs get
+  warm-start across processes; ``core.deploy`` forwards the env into
+  the container so deployed jobs inherit it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from cloud_tpu.monitoring import metrics, tracing
+
+logger = logging.getLogger(__name__)
+
+#: Directory for jax's on-disk compilation cache; unset/"off" disables.
+ENV_COMPILE_CACHE = "CLOUD_TPU_COMPILE_CACHE"
+#: Set to 1 to bypass the known-bad jaxlib blocklist (the probe still runs).
+ENV_COMPILE_CACHE_FORCE = "CLOUD_TPU_COMPILE_CACHE_FORCE"
+#: Override jax's min-compile-time-to-cache threshold (seconds; default 0 —
+#: the jobs this launcher targets are small, so cache everything).
+ENV_COMPILE_CACHE_MIN_SECS = "CLOUD_TPU_COMPILE_CACHE_MIN_SECS"
+
+#: jaxlib versions whose executable (de)serialization is known memory-unsafe
+#: (tests/conftest.py records the observed SIGSEGV / "corrupted
+#: double-linked list" aborts).  Refused without the FORCE env because the
+#: corruption strikes *in-process*, after the probe child already exited
+#: clean on a smaller executable.
+KNOWN_BAD_JAXLIB = ("0.4.36", "0.4.37")
+
+
+# --------------------------------------------------------------------------
+# Abstract avals
+
+
+def _canonical_dtype(dtype):
+    import jax
+
+    return jax.dtypes.canonicalize_dtype(np.dtype(dtype))
+
+
+def abstract_state(state):
+    """ShapeDtypeStruct pytree for a live TrainState (shardings preserved,
+    so the AOT executable compiles for the exact placement jit would)."""
+    import jax
+
+    def aval(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        x = np.asarray(x)
+        return jax.ShapeDtypeStruct(x.shape, _canonical_dtype(x.dtype))
+
+    return jax.tree_util.tree_map(aval, state)
+
+
+def abstract_batch(batch, mesh=None, rules=None, *, stacked: bool = False,
+                   batch_axis: str = "batch"):
+    """ShapeDtypeStruct pytree for a batch AS THE STEP WILL SEE IT.
+
+    Device-placed leaves keep their shardings verbatim; host leaves get
+    the sharding ``train.shard_batch`` would commit them to (dim 0 on the
+    data axes; ``stacked=True`` = super-batch layout with a replicated
+    leading step axis).  Accepts a concrete batch or a ``batch_spec``
+    pytree of anything with ``.shape``/``.dtype``.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    lead = [None, batch_axis] if stacked else [batch_axis]
+
+    def aval(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        shape = tuple(x.shape)
+        dtype = _canonical_dtype(x.dtype)
+        if mesh is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        spec = rules.spec(*(lead + [None] * (len(shape) - len(lead))))
+        return jax.ShapeDtypeStruct(
+            shape, dtype, sharding=NamedSharding(mesh, spec)
+        )
+
+    return jax.tree_util.tree_map(aval, batch)
+
+
+def _args_key(args) -> Tuple:
+    """Hashable identity of a lowering's abstract inputs."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (
+        str(treedef),
+        tuple(
+            (tuple(leaf.shape), str(leaf.dtype), str(getattr(leaf, "sharding", None)))
+            for leaf in leaves
+        ),
+    )
+
+
+def context_key(*, mesh=None, rules=None, donation: Tuple[int, ...] = (),
+                steps_per_dispatch: int = 1) -> Tuple:
+    """The non-aval half of a registry key: mesh layout, sharding rules,
+    donation signature, and K (the fused-dispatch width)."""
+    mesh_key = None
+    if mesh is not None:
+        mesh_key = (
+            tuple(mesh.shape.items()),
+            tuple(int(d.id) for d in mesh.devices.flat),
+        )
+    rules_key = None
+    if rules is not None:
+        rules_key = tuple(sorted(rules.rules.items()))
+    return (mesh_key, rules_key, tuple(donation), int(steps_per_dispatch))
+
+
+# --------------------------------------------------------------------------
+# AOT registry
+
+_registry: Dict[Tuple, Tuple[Any, Any]] = {}
+_registry_lock = threading.Lock()
+
+#: Registry bound: entries hold STRONG refs to the jitted fn (closure,
+#: optimizer, mesh) and its compiled executable, so an unbounded registry
+#: grows linearly in a long-lived process that keeps building Trainers
+#: (a tuner loop).  FIFO-evict past this; jit's own dispatch cache still
+#: backs an evicted fit, which just pays one lower+compile again.
+REGISTRY_MAX_ENTRIES = 64
+
+
+def aot_compile(jitted, *args, label: str = "step"):
+    """``jitted.lower(*args).compile()`` with cold-start attribution spans.
+
+    ``args`` may be concrete arrays, ShapeDtypeStructs, or a mix; nothing
+    executes.  The two phases are spanned separately because they fail —
+    and cost — differently: ``compile/lower`` is Python tracing,
+    ``compile/backend_compile`` is XLA.
+    """
+    with tracing.span("compile/lower", fn=label):
+        lowered = jitted.lower(*args)
+    with tracing.span("compile/backend_compile", fn=label):
+        return lowered.compile()
+
+
+def get_or_compile(jitted, args, *, context: Tuple = (), label: str = "step"):
+    """Registry-memoized :func:`aot_compile`.
+
+    The key is (fn identity, context, abstract avals of ``args``); the
+    entry holds a strong ref to ``jitted`` so a recycled ``id()`` can
+    never alias a dead function's executables.  The registry is bounded
+    at :data:`REGISTRY_MAX_ENTRIES` (FIFO eviction — an evicted fit
+    falls back to jit's own cache or one recompile);
+    :func:`clear_registry` drops everything.
+    """
+    key = (id(jitted), context, _args_key(args))
+    with _registry_lock:
+        entry = _registry.get(key)
+    if entry is not None and entry[0] is jitted:
+        metrics.counter_inc("compile/registry_hit")
+        return entry[1]
+    metrics.counter_inc("compile/registry_miss")
+    compiled = aot_compile(jitted, *args, label=label)
+    with _registry_lock:
+        while len(_registry) >= REGISTRY_MAX_ENTRIES:
+            _registry.pop(next(iter(_registry)))
+        _registry[key] = (jitted, compiled)
+    return compiled
+
+
+def clear_registry() -> None:
+    with _registry_lock:
+        _registry.clear()
+
+
+def registry_size() -> int:
+    with _registry_lock:
+        return len(_registry)
+
+
+class AotStep:
+    """Dispatch wrapper: the AOT executable when inputs match, jit otherwise.
+
+    A compiled executable rejects mismatched input avals with a
+    ``TypeError`` *before* executing (donated buffers are untouched), so
+    the fallback costs nothing on the happy path — no per-dispatch shape
+    walk, just one try.  The first mismatch permanently reverts this
+    wrapper to the jitted function (shapes are stable within a fit; a
+    mismatch means the caller moved on to different shapes, where jit's
+    own cache is the right home).
+    """
+
+    __slots__ = ("jitted", "label", "_compiled")
+
+    def __init__(self, jitted, label: str = "step"):
+        self.jitted = jitted
+        self.label = label
+        self._compiled = None
+
+    @property
+    def compiled(self):
+        return self._compiled
+
+    def attach(self, compiled) -> None:
+        self._compiled = compiled
+
+    def __call__(self, *args):
+        compiled = self._compiled
+        if compiled is not None:
+            try:
+                return compiled(*args)
+            except TypeError as exc:
+                logger.warning(
+                    "compile-ahead executable for %s rejected its inputs "
+                    "(%s); falling back to jit dispatch", self.label, exc,
+                )
+                self._compiled = None
+        return self.jitted(*args)
+
+
+# --------------------------------------------------------------------------
+# Background compile-ahead
+
+
+class CompileAhead:
+    """A fit's background-compile plan: AotStep wrappers + the worker.
+
+    ``wait(label)`` blocks until that ONE job has compiled (spanned as
+    ``compile/ahead_wait`` — with prefetch warming in parallel this is ~0
+    by the time the first window arrives, which is the whole point); jobs
+    queued after it — the eval step rides behind the train step — keep
+    compiling in the background and never delay the first dispatch.
+    ``wait()`` with no label joins the whole worker.  A compile failure
+    is recorded in ``error`` and logged, never raised: the wrappers
+    simply stay on the jit path.
+    """
+
+    def __init__(self, steps: Dict[str, AotStep]):
+        self.steps = steps
+        self.error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._done = {label: threading.Event() for label in steps}
+
+    def _launch(self, jobs) -> None:
+        def worker():
+            for aot_step, args, ctx in jobs:
+                try:
+                    if callable(args):
+                        # Deferred avals (e.g. the eval job peeking the
+                        # validation data's first batch): resolved HERE,
+                        # off the main thread, so a slow pipeline never
+                        # delays the jobs queued before it — or fit().
+                        args = args()
+                    if args is None:
+                        continue  # thunk found nothing to compile against
+                    aot_step.attach(get_or_compile(
+                        aot_step.jitted, args, context=ctx,
+                        label=aot_step.label,
+                    ))
+                except BaseException as exc:  # noqa: BLE001 — advisory only
+                    self.error = exc
+                    logger.warning(
+                        "compile-ahead of %s failed (%s); that step will "
+                        "compile on first dispatch instead",
+                        aot_step.label, exc,
+                    )
+                finally:
+                    self._done[aot_step.label].set()
+
+        self._thread = threading.Thread(
+            target=worker, daemon=True, name="cloud-tpu-compile-ahead"
+        )
+        self._thread.start()
+
+    def wait(self, label: Optional[str] = None,
+             timeout: Optional[float] = None) -> None:
+        if label is not None:
+            event = self._done.get(label)
+            if event is None or event.is_set():
+                return
+            with tracing.span("compile/ahead_wait", fn=label):
+                event.wait(timeout)
+            return
+        thread = self._thread
+        if thread is None or not thread.is_alive():
+            return
+        with tracing.span("compile/ahead_wait"):
+            thread.join(timeout)
+
+
+def start_compile_ahead(jobs) -> CompileAhead:
+    """Launch a background compile of ``jobs``.
+
+    ``jobs`` is a list of ``(AotStep, abstract_args, context_key)``
+    triples; compilation happens strictly in order on one worker thread
+    (XLA compiles hold the CPU — parallel compiles would fight the
+    prefetcher for cores without finishing sooner).  ``abstract_args``
+    may instead be a zero-arg callable, resolved on the worker right
+    before that job compiles (return None to skip the job) — for avals
+    that themselves cost a blocking peek, like the eval step's
+    validation batch.
+    """
+    steps = {job[0].label: job[0] for job in jobs}
+    plan = CompileAhead(steps)
+    plan._launch(jobs)
+    return plan
+
+
+# --------------------------------------------------------------------------
+# Safe persistent cache
+
+_persist_lock = threading.Lock()
+_persist_state: Dict[str, Any] = {"checked": False, "enabled": False,
+                                  "dir": None}
+
+#: The child probe: a trainer-shaped jitted step (dict pytree, grad,
+#: donation — the executable class whose (de)serialization corrupted the
+#: heap on jaxlib 0.4.36/0.4.37) compiled once to POPULATE the on-disk
+#: cache, then recompiled from disk after dropping the in-memory caches,
+#: executed, and numerically compared.  Heap corruption anywhere in that
+#: round-trip kills the child (SIGSEGV / glibc abort), which is exactly
+#: the signal: only a clean exit + the OK marker enables the cache
+#: in-process.  Runs on CPU (JAX_PLATFORMS pinned by the parent) so the
+#: probe never contends with the training process for the accelerator —
+#: the (de)serialization path under test is host-side.
+_PROBE_SOURCE = """
+import sys
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", sys.argv[1])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+try:
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:
+    pass
+
+
+def step(state, batch):
+    def loss(w):
+        return ((batch["x"] @ w - batch["y"]) ** 2).mean()
+
+    g = jax.grad(loss)(state["w"])
+    return {"w": state["w"] - 0.1 * g}
+
+
+jitted = jax.jit(step, donate_argnums=0)
+batch = {"x": jnp.ones((8, 4)), "y": jnp.ones((8, 2))}
+want = jitted({"w": jnp.zeros((4, 2))}, batch)["w"]
+jax.clear_caches()  # drop in-memory caches: the next compile reads DISK
+got = jitted({"w": jnp.zeros((4, 2))}, batch)["w"]
+assert bool(jnp.allclose(got, jnp.asarray(want))), "round-trip changed numerics"
+print("CLOUD_TPU_CACHE_PROBE_OK")
+"""
+
+_PROBE_OK_MARKER = "CLOUD_TPU_CACHE_PROBE_OK"
+
+
+def _probe_marker_path(cache_dir: str) -> str:
+    import jax
+    import jaxlib
+
+    return os.path.join(
+        cache_dir,
+        f".cloud_tpu_probe_ok-jax{jax.__version__}-jaxlib{jaxlib.__version__}",
+    )
+
+
+def _run_probe_child(cache_dir: str, timeout: float) -> Tuple[int, str]:
+    """Run the round-trip probe in a child; returns (returncode, stdout).
+
+    The child inherits the environment minus accelerator claims
+    (JAX_PLATFORMS=cpu) so it cannot steal the TPU from the process that
+    is about to train.  Any crash — the failure mode under test — is a
+    nonzero returncode here, not a dead training job.
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SOURCE, cache_dir],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return -1, "probe timed out"
+    except OSError as exc:
+        return -1, f"probe failed to launch: {exc}"
+    out = (proc.stdout or "") + (proc.stderr or "")
+    return proc.returncode, out
+
+
+def maybe_enable_persistent_cache(
+    cache_dir: Optional[str] = None,
+    *,
+    force: Optional[bool] = None,
+    probe_timeout: float = 120.0,
+) -> bool:
+    """Enable jax's on-disk compilation cache iff it is provably safe here.
+
+    Reads ``CLOUD_TPU_COMPILE_CACHE`` (or the explicit ``cache_dir``);
+    unset / empty / ``off`` / ``0`` means disabled and this is a cheap
+    no-op — safe to call from every ``Trainer.fit``.  The decision is
+    made once per process and cached; pass a different explicit
+    ``cache_dir`` to re-decide.
+
+    Enablement requires, in order: (1) the jaxlib is not on
+    :data:`KNOWN_BAD_JAXLIB` (override with
+    ``CLOUD_TPU_COMPILE_CACHE_FORCE=1`` / ``force=True`` — the probe
+    still runs); (2) the one-time child-process round-trip probe exits
+    clean (a prior pass recorded in a per-jax-version marker file inside
+    the cache dir short-circuits the child, which is what gives a SECOND
+    process its warm start without paying the probe again).  Only then
+    is the cache turned on in-process, with the min-compile-time
+    threshold from ``CLOUD_TPU_COMPILE_CACHE_MIN_SECS`` (default 0:
+    cache everything — these jobs are small and first-step latency is
+    the metric).
+    """
+    explicit = cache_dir is not None
+    if cache_dir is None:
+        cache_dir = os.environ.get(ENV_COMPILE_CACHE, "")
+    if not cache_dir or cache_dir.strip().lower() in ("off", "0", "false"):
+        return False
+    with _persist_lock:
+        if _persist_state["checked"] and (
+            not explicit or _persist_state["dir"] == cache_dir
+        ):
+            return _persist_state["enabled"]
+
+    if force is None:
+        force = os.environ.get(ENV_COMPILE_CACHE_FORCE, "").lower() in (
+            "1", "true"
+        )
+    import jaxlib
+
+    if jaxlib.__version__ in KNOWN_BAD_JAXLIB and not force:
+        logger.warning(
+            "%s=%s ignored: jaxlib %s executable (de)serialization is "
+            "known memory-unsafe (set %s=1 to probe anyway)",
+            ENV_COMPILE_CACHE, cache_dir, jaxlib.__version__,
+            ENV_COMPILE_CACHE_FORCE,
+        )
+        with _persist_lock:
+            _persist_state.update(checked=True, enabled=False, dir=cache_dir)
+        return False
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError as exc:
+        logger.warning("compile cache dir %s unusable: %s", cache_dir, exc)
+        with _persist_lock:
+            _persist_state.update(checked=True, enabled=False, dir=cache_dir)
+        return False
+
+    marker = _probe_marker_path(cache_dir)
+    if not os.path.exists(marker):
+        with tracing.span("compile/cache_probe"):
+            rc, out = _run_probe_child(cache_dir, probe_timeout)
+        if rc != 0 or _PROBE_OK_MARKER not in out:
+            logger.warning(
+                "persistent compile cache DISABLED: round-trip probe "
+                "failed (rc=%s): %s", rc, out.strip()[-500:],
+            )
+            metrics.counter_inc("compile/cache_probe_failed")
+            with _persist_lock:
+                _persist_state.update(
+                    checked=True, enabled=False, dir=cache_dir
+                )
+            return False
+        try:
+            with open(marker, "w", encoding="utf-8") as f:
+                f.write(out.strip()[:200] + "\n")
+        except OSError:
+            pass  # marker is an optimization; next process re-probes
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    try:
+        min_secs = float(os.environ.get(ENV_COMPILE_CACHE_MIN_SECS, "0"))
+    except ValueError:
+        min_secs = 0.0
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_secs)
+    except Exception:  # noqa: BLE001 — knob name varies across jax versions
+        pass
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001
+        pass
+    logger.info("persistent compile cache enabled at %s", cache_dir)
+    metrics.counter_inc("compile/cache_enabled")
+    with _persist_lock:
+        _persist_state.update(checked=True, enabled=True, dir=cache_dir)
+    return True
+
+
+def persistent_cache_enabled() -> bool:
+    with _persist_lock:
+        return bool(_persist_state["enabled"])
+
+
+def _reset_persistent_state_for_tests() -> None:
+    """Forget the once-per-process decision AND restore jax's defaults."""
+    import jax
+
+    with _persist_lock:
+        was_enabled = _persist_state["enabled"]
+        _persist_state.update(checked=False, enabled=False, dir=None)
+    if was_enabled:
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", 0
+            )
+        except Exception:  # noqa: BLE001
+            pass

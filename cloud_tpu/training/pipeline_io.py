@@ -19,7 +19,9 @@ input + dispatch overhead, not kernel time):
   consecutive batches, stack them into one super-batch with a leading
   step axis, and place it on device (in the background thread for the
   prefetching variant).  A short tail window (dataset exhausted
-  mid-window) is delivered as individual per-step batches.
+  mid-window) is zero-padded to the full window shape with a per-step
+  validity mask, so the consumer reuses the compiled K-step executable
+  instead of tracing a fallback mid-epoch.
 
 The consumer-facing wait is spanned as ``step/prefetch_wait``: with the
 queue warm it is ~0 (input is not the bottleneck); when it dominates the
@@ -56,6 +58,7 @@ class PrefetchIterator:
     def __init__(self, source: Iterator, place: Callable, size: int):
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, size))
         self._stop = threading.Event()
+        self._exhausted = False
         # The worker must NOT capture ``self``: the Thread object would
         # then keep the iterator alive, ``__del__`` could never fire for
         # an abandoned iterator, and the worker (blocked on its bounded
@@ -96,6 +99,11 @@ class PrefetchIterator:
         return self
 
     def __next__(self):
+        # Exhausted stays exhausted: a consumer that peeked past the end
+        # (compile-ahead's aval probe) and iterates again must get
+        # StopIteration, not a forever-block on the empty queue.
+        if self._exhausted:
+            raise StopIteration
         # The get() is the consumer's actual input-wait: ~0 while the
         # worker keeps the queue warm, the full host-pipeline latency when
         # input is the bottleneck.  Spanned so the step breakdown shows
@@ -103,6 +111,7 @@ class PrefetchIterator:
         with tracing.span("step/prefetch_wait"):
             item = self._queue.get()
         if item is self._DONE:
+            self._exhausted = True
             self._thread.join()
             if self._error_box:
                 raise self._error_box[0]
@@ -258,25 +267,55 @@ def windowed(source: Iterator, k: int, limit: Optional[int] = None) -> Iterator[
 
 
 def _window_placer(k: int, mesh, rules, counted: bool):
-    """Maps a window (list of host batches) to ``(n_steps, payload)``:
-    a stacked+placed super-batch for a full window, a list of placed
-    per-step batches for a short tail."""
+    """Maps a window (list of host batches) to ``(n_steps, payload, valid)``.
 
-    def place_window(window: List) -> Tuple[int, object]:
+    ``payload`` is normally a stacked + placed super-batch with leading
+    axis exactly ``k``: a tail window shorter than k is zero-padded up to
+    the compiled window shape (``sharding.pad_batch``) and ``valid``
+    (float32 ``[k]``) marks the real steps — so the consumer dispatches
+    the SAME fused executable for tails, with the padded slots skipped
+    inside the scan (``train.make_multi_step``'s ``valid`` argument)
+    instead of tracing a single-step fallback mid-epoch.
+
+    A RAGGED window — batches whose own leading (example) dims differ,
+    e.g. a ``drop_remainder=False`` dataset's short final batch — cannot
+    stack: it degrades to ``(n, [placed per-step batches], None)`` and
+    the consumer runs those as single-step dispatches (``valid is None``
+    is the marker).  Avoid ragged finals (drop the remainder, or pad via
+    ``shard_batch(pad_to=...)`` + a loss mask) to keep the one-compile
+    guarantee.
+    """
+
+    def place_window(window: List) -> Tuple[int, object, object]:
+        from cloud_tpu.parallel.sharding import pad_batch
+
         n = len(window)
-        if n == k and k > 1:
-            payload = _place_batch(
-                stack_batches(window), mesh, rules, stacked=True
-            )
-        else:
-            payload = [_place_batch(b, mesh, rules) for b in window]
         if counted:
             from cloud_tpu.monitoring import metrics as _metrics
 
             _metrics.counter_inc("data/host_to_device_batches", n)
-        return n, payload
+        # Stackable iff every batch has the identical per-leaf shape tree
+        # (np.stack's own requirement).  Comparing whole signatures — not
+        # pooled leading dims — keeps batches whose DIFFERENT leaves have
+        # different leading dims (or scalar leaves) on the fused path.
+        def signature(batch):
+            return [np.shape(leaf) for leaf in _tree_leaves(batch)]
+
+        first_sig = signature(window[0])
+        if any(signature(b) != first_sig for b in window[1:]):
+            return n, [_place_batch(b, mesh, rules) for b in window], None
+        stacked = stack_batches(window)
+        stacked, valid = pad_batch(stacked, k)
+        payload = _place_batch(stacked, mesh, rules, stacked=True)
+        return n, payload, valid
 
     return place_window
+
+
+def _tree_leaves(batch):
+    import jax
+
+    return jax.tree_util.tree_leaves(batch)
 
 
 def prefetch_windows(
@@ -293,8 +332,11 @@ def prefetch_windows(
     The worker thread gathers ``steps_per_dispatch`` host batches, stacks
     them into one super-batch (leading step axis), and places it on device
     ``size`` windows ahead of the consumer — the multi-step dispatch never
-    waits on host gather or H2D transfer.  Yields ``(n_steps, payload)``;
-    a short tail window comes back as a list of per-step batches instead.
+    waits on host gather or H2D transfer.  Yields
+    ``(n_steps, payload, valid)``; a short tail window arrives zero-padded
+    to the full window shape with ``valid`` marking its real steps (see
+    :func:`_window_placer`), so padding happens on the worker thread, off
+    the dispatch critical path.
     """
     rules = _resolve_rules(rules)
     place = _window_placer(steps_per_dispatch, mesh, rules, counted=True)
@@ -317,7 +359,7 @@ def iter_windows(
     limit: Optional[int] = None,
 ) -> Callable[[], Iterator]:
     """Synchronous sibling of :func:`prefetch_windows` (``prefetch=0``):
-    same ``(n_steps, payload)`` stream, no background thread."""
+    same ``(n_steps, payload, valid)`` stream, no background thread."""
     rules = _resolve_rules(rules)
     place = _window_placer(steps_per_dispatch, mesh, rules, counted=False)
 

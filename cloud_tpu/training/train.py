@@ -297,6 +297,17 @@ def make_multi_step(
     observable from the host.  The input state is donated, and the scan
     carries it in place; per-step metrics never accumulate host-side.
 
+    ``multi_step(state, super_batch, valid)`` additionally accepts a
+    float32 ``[K]`` per-step validity mask (``sharding.pad_batch``): a
+    dataset tail shorter than K is zero-padded to the compiled window
+    shape and the padded slots are SKIPPED via ``lax.cond`` — no step
+    body runs, the carried state (params, opt_state, rng, step counter)
+    passes through untouched, and the window metrics average over valid
+    steps only.  One executable therefore serves full windows and tails
+    alike (valid steps execute the identical step body, so the
+    trajectory matches the unpadded run exactly).  ``valid=None`` keeps
+    the original two-argument contract.
+
     The scan traces the step body once: compile cost does not grow with K,
     and re-dispatching with the same shapes hits the jit cache (guarded by
     tests/unit/test_pipeline_engine.py).
@@ -310,7 +321,9 @@ def make_multi_step(
         mesh=mesh, stochastic=stochastic, accum_steps=accum_steps,
     )
 
-    def multi_step(state: TrainState, super_batch) -> Tuple[TrainState, Dict]:
+    def multi_step(
+        state: TrainState, super_batch, valid=None
+    ) -> Tuple[TrainState, Dict]:
         leaves = jax.tree_util.tree_leaves(super_batch)
         if leaves and leaves[0].shape[0] != steps_per_dispatch:
             raise ValueError(
@@ -318,16 +331,45 @@ def make_multi_step(
                 f"steps_per_dispatch={steps_per_dispatch}"
             )
 
-        def body(carry, batch):
+        def run(carry, batch):
             new_state, metrics = step(carry, batch)
             return new_state, {
                 k: v.astype(jnp.float32) for k, v in metrics.items()
             }
 
-        state, stacked = jax.lax.scan(body, state, super_batch)
-        # Window means in f32, on device: the host sees K steps' worth of
-        # metrics as one small pytree, not K pinned buffers.
-        metrics = {k: jnp.mean(v, axis=0) for k, v in stacked.items()}
+        if valid is None:
+            state, stacked = jax.lax.scan(run, state, super_batch)
+            # Window means in f32, on device: the host sees K steps' worth
+            # of metrics as one small pytree, not K pinned buffers.
+            metrics = {k: jnp.mean(v, axis=0) for k, v in stacked.items()}
+            return state, metrics
+
+        # Metric STRUCTURE from one abstract eval (no FLOPs) so the
+        # skipped branch can return matching zeros.
+        one_batch = jax.tree_util.tree_map(lambda x: x[0], super_batch)
+        metric_shapes = jax.eval_shape(run, state, one_batch)[1]
+
+        def body(carry, xs):
+            batch, v = xs
+
+            def skip(c):
+                return c, {
+                    k: jnp.zeros(s.shape, jnp.float32)
+                    for k, s in metric_shapes.items()
+                }
+
+            # cond, not select: the padded slot's step body never executes
+            # (no wasted FLOPs, no NaN from zero-filled inputs, no
+            # params-sized select on the valid steps' fast path).
+            return jax.lax.cond(
+                v > 0, lambda c: run(c, batch), skip, carry
+            )
+
+        state, stacked = jax.lax.scan(body, state, (super_batch, valid))
+        n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+        metrics = {
+            k: jnp.sum(v, axis=0) / n_valid for k, v in stacked.items()
+        }
         return state, metrics
 
     return jax.jit(multi_step, donate_argnums=0)
@@ -410,7 +452,8 @@ def make_hybrid_dp_train_step(
 
 def shard_batch(batch, mesh: Optional[Mesh],
                 rules: ShardingRules = DEFAULT_RULES,
-                batch_axis: str = "batch", *, stacked: bool = False):
+                batch_axis: str = "batch", *, stacked: bool = False,
+                pad_to: Optional[int] = None):
     """Place a batch pytree onto the mesh, sharded on dim 0.
 
     Single-process: ``batch`` is the global batch; a plain sharded
@@ -424,7 +467,26 @@ def shard_batch(batch, mesh: Optional[Mesh],
     ``stacked=True`` places a multi-step super-batch (leading axis = steps
     per dispatch, ``make_multi_step``): the step axis stays replicated and
     the BATCH axis moves to dim 1.
+
+    ``pad_to=N`` zero-pads the BATCH dimension of every (host) leaf to N
+    before placement — dim 0 for a plain batch, dim 1 for a
+    ``stacked=True`` super-batch — and changes the return to
+    ``(batch, valid)``, with ``valid`` a float32 ``[N]`` PER-EXAMPLE mask
+    of real rows.  This is the ragged-final-batch escape hatch: pad to
+    the compiled batch size instead of paying a fresh compile, and gate
+    the loss with the mask (e.g. fold it into ``loss_mask`` for the LM
+    losses).  The windowing pipelines use sibling machinery per STEP
+    (``sharding.pad_batch`` on the stacked super-batch's dim 0) so
+    dataset tails reuse the fused executable.
     """
+    if pad_to is not None:
+        from cloud_tpu.parallel.sharding import pad_batch
+
+        batch, valid = pad_batch(batch, pad_to, axis=1 if stacked else 0)
+        return (
+            shard_batch(batch, mesh, rules, batch_axis, stacked=stacked),
+            valid,
+        )
     if mesh is None:
         return batch
 

@@ -20,10 +20,44 @@ import numpy as np
 
 from cloud_tpu.monitoring import tracing
 from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules
-from cloud_tpu.training import pipeline_io
+from cloud_tpu.training import compile_cache, pipeline_io
 from cloud_tpu.training import train as train_lib
 
 logger = logging.getLogger(__name__)
+
+
+class _PeekedIterator:
+    """An iterator with its first item already pulled (compile-ahead peeks
+    one batch to derive abstract avals, then the epoch loop must still
+    consume it).  Delegates ``close`` so prefetch workers are joined."""
+
+    _EMPTY = object()  # the peek found the source already exhausted
+
+    def __init__(self, first, rest):
+        self._first = first if first is not None else self._EMPTY
+        self._rest = rest
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        first = self._first
+        if first is self._EMPTY:
+            # Never re-pull an exhausted source (a drained prefetch queue
+            # has no more DONE sentinels to deliver).
+            raise StopIteration
+        if first is not None:
+            # Hand the peeked item over WITHOUT keeping a reference: for
+            # K>1 it is a whole placed super-batch — pinning it for the
+            # epoch would hold K batches of device memory hostage.
+            self._first = None
+            return first
+        return next(self._rest)
+
+    def close(self):
+        close = getattr(self._rest, "close", None)
+        if close is not None:
+            close()
 
 
 class Callback:
@@ -348,6 +382,8 @@ class Trainer:
         state: Optional[train_lib.TrainState] = None,
         steps_per_dispatch: int = 1,
         prefetch: int = 2,
+        compile_ahead: bool = False,
+        batch_spec=None,
     ) -> History:
         """Run the training loop.
 
@@ -366,14 +402,35 @@ class Trainer:
         overhead (dispatch, callback fan-out) amortizes K-fold.  The
         parameter trajectory is unchanged; the observable cadence is:
         ``on_step_end`` fires once per window with window-MEAN metrics
-        (TerminateOnNaN therefore detects a NaN up to K-1 steps late), and
-        a dataset tail shorter than K falls back to single-step dispatches.
-        ``K=1`` preserves exact per-step semantics.
+        (TerminateOnNaN therefore detects a NaN up to K-1 steps late).  A
+        dataset tail shorter than K is zero-padded to the compiled window
+        shape and dispatched through the SAME fused executable with the
+        padded steps skipped on device (``sharding.pad_batch`` +
+        ``make_multi_step``'s validity mask) — one compile covers the
+        whole epoch, tail included, with exact metric parity.  ``K=1``
+        preserves exact per-step semantics.
+
+        ``compile_ahead=True`` compiles this fit's step executables
+        (train or K-step fused, plus eval when ``validation_data`` is
+        given) on a background thread WHILE the prefetcher warms, so the
+        first dispatch finds a ready executable instead of paying
+        lower+compile synchronously — first-step latency still lands in
+        the ``run/submit_to_first_step_seconds`` gauge, now measuring
+        overlap instead of a serial compile.  Abstract input avals come
+        from the first prefetched batch, or from ``batch_spec`` (a pytree
+        matching one HOST batch's ``.shape``/``.dtype``, e.g. numpy
+        arrays or ``jax.ShapeDtypeStruct``s) when the data pipeline is
+        slow to produce its first batch.  Executables are memoized in
+        ``compile_cache``'s AOT registry, and a failure to compile ahead
+        degrades to normal jit dispatch — never an error.
         """
         if steps_per_dispatch < 1:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}"
             )
+        # Env-gated persistent executable cache (CLOUD_TPU_COMPILE_CACHE):
+        # a once-per-process probe + enable, a cheap no-op when unset.
+        compile_cache.maybe_enable_persistent_cache()
         if state is not None:
             self.state = state
         if self.state is None:
@@ -412,6 +469,27 @@ class Trainer:
                 )
             multi_step = self._multi_step_for(k)
 
+        # Compile-ahead: spawn the background compile (against avals from
+        # batch_spec or a peeked first batch) BEFORE the epoch loop, so it
+        # overlaps the prefetcher warming.  The step callables are swapped
+        # for AotStep wrappers that dispatch through the ready executable.
+        train_step = self._train_step
+        eval_step = None
+        aot_plan = None
+        peeked_iter = None
+        if compile_ahead:
+            aot_plan, peeked_iter = self._launch_compile_ahead(
+                k, source, batch_spec,
+                validation_data=validation_data,
+                multi_step=multi_step,
+            )
+            if aot_plan is not None:
+                if k == 1:
+                    train_step = aot_plan.steps["train_step"]
+                else:
+                    multi_step = aot_plan.steps["multi_step"]
+                eval_step = aot_plan.steps.get("eval_step")
+
         for cb in callbacks:
             cb.on_train_begin(self)
         step = int(self.state.step)
@@ -431,7 +509,12 @@ class Trainer:
             epoch_sums: Dict[str, Any] = {}
             epoch_steps = 0
             epoch_start = time.perf_counter()
-            data_iter = iter(source())
+            if peeked_iter is not None:
+                # Epoch 0 with compile-ahead: the avals peek already
+                # started this epoch's iterator (prefetch warm underneath).
+                data_iter, peeked_iter = peeked_iter, None
+            else:
+                data_iter = iter(source())
             try:
                 if k == 1:
                     i = 0
@@ -440,6 +523,12 @@ class Trainer:
                             batch = next(data_iter, None)
                         if batch is None:
                             break
+                        if first_dispatch and aot_plan is not None:
+                            # Wait for the TRAIN executable only: by now
+                            # its compile has been overlapping prefetch
+                            # warmup (~0 wait when that paid off), and the
+                            # eval compile keeps going in the background.
+                            aot_plan.wait("train_step")
                         compute_span = (
                             "step/first_compile" if first_dispatch
                             else "step/compute"
@@ -449,7 +538,7 @@ class Trainer:
                                 batch, self.mesh, self.rules
                             )
                             with self._mesh_context():
-                                self.state, metrics = self._train_step(
+                                self.state, metrics = train_step(
                                     self.state, batch
                                 )
                         if first_dispatch:
@@ -474,8 +563,38 @@ class Trainer:
                             item = next(data_iter, None)
                         if item is None:
                             break
-                        n, payload = item
-                        if n == k:
+                        # Every window — tail included — dispatches the ONE
+                        # compiled fused executable: a short window arrives
+                        # zero-padded to the full K shape with `valid`
+                        # marking its real steps, and the scan skips the
+                        # padded slots on device (make_multi_step).  The
+                        # only remaining single-step fallback is a RAGGED
+                        # window (valid None: per-batch example dims
+                        # differ, so no stacking is possible).
+                        n, payload, valid = item
+                        if valid is None:
+                            compute_span = (
+                                "step/first_compile" if first_dispatch
+                                else "step/compute"
+                            )
+                            with tracing.span(compute_span, steps=n):
+                                with self._mesh_context():
+                                    ragged: Dict[str, Any] = {}
+                                    for batch in payload:
+                                        self.state, m = self._train_step(
+                                            self.state, batch
+                                        )
+                                        self._accumulate(ragged, m, 1)
+                                    metrics = {
+                                        key: value / n
+                                        for key, value in ragged.items()
+                                    }
+                        else:
+                            if first_dispatch and aot_plan is not None:
+                                # Only a FUSED dispatch consumes the
+                                # compiled executable; a ragged first
+                                # window must not stall on it.
+                                aot_plan.wait("multi_step")
                             compute_span = (
                                 "step/first_compile" if first_dispatch
                                 else "step/fused_compute"
@@ -483,28 +602,8 @@ class Trainer:
                             with tracing.span(compute_span, steps=n):
                                 with self._mesh_context():
                                     self.state, metrics = multi_step(
-                                        self.state, payload
+                                        self.state, payload, valid
                                     )
-                        else:
-                            # Dataset tail shorter than K: single-step
-                            # dispatches, averaged so the callback cadence
-                            # stays one call per window.
-                            compute_span = (
-                                "step/first_compile" if first_dispatch
-                                else "step/compute"
-                            )
-                            with tracing.span(compute_span, steps=n):
-                                with self._mesh_context():
-                                    tail: Dict[str, Any] = {}
-                                    for batch in payload:
-                                        self.state, m = self._train_step(
-                                            self.state, batch
-                                        )
-                                        self._accumulate(tail, m, 1)
-                                    metrics = {
-                                        key: value / n
-                                        for key, value in tail.items()
-                                    }
                         if first_dispatch:
                             first_dispatch = False
                             tracing.record_submit_to_first_step()
@@ -530,7 +629,9 @@ class Trainer:
             }
             logs["epoch_seconds"] = time.perf_counter() - epoch_start
             if validation_data is not None:
-                val = self.evaluate(validation_data, prefetch=prefetch)
+                val = self.evaluate(
+                    validation_data, prefetch=prefetch, step_fn=eval_step
+                )
                 logs.update({f"val_{k_}": v for k_, v in val.items()})
             for cb in callbacks:
                 cb.on_epoch_end(epoch, logs, self)
@@ -539,7 +640,10 @@ class Trainer:
         return history
 
     def evaluate(self, data: Callable[[], Iterable], *,
-                 prefetch: int = 2) -> Dict[str, float]:
+                 prefetch: int = 2, step_fn=None) -> Dict[str, float]:
+        """``step_fn`` overrides the eval step callable (fit passes the
+        compile-ahead :class:`compile_cache.AotStep` wrapper through)."""
+        step_fn = step_fn if step_fn is not None else self._eval_step
         source = data
         if prefetch > 0 and not pipeline_io.is_prefetched(data):
             source = pipeline_io.prefetch_to_device(
@@ -552,7 +656,7 @@ class Trainer:
             for batch in data_iter:
                 batch = train_lib.shard_batch(batch, self.mesh, self.rules)
                 with self._mesh_context():
-                    metrics = self._eval_step(self.state, batch)
+                    metrics = step_fn(self.state, batch)
                 self._accumulate(sums, metrics, 1)
                 count += 1
         finally:
@@ -561,6 +665,118 @@ class Trainer:
                 close()
         host = jax.device_get(sums)
         return {k: float(np.mean(v) / max(count, 1)) for k, v in host.items()}
+
+    def _launch_compile_ahead(self, k, source, batch_spec, *,
+                              validation_data, multi_step):
+        """Derive abstract input avals and start the background compile.
+
+        Returns ``(plan, peeked_iter)``.  ``peeked_iter`` is non-None when
+        the first batch/window of epoch 0 was pulled to derive avals — the
+        epoch loop must consume it (the underlying prefetcher keeps
+        warming meanwhile, which is exactly the window the compile
+        overlaps).  Eval avals come from a peek at ``validation_data``'s
+        own first batch — never inferred from the train batch, since the
+        two may be shaped differently — deferred onto the compile worker
+        (after the train-step job) so a slow validation pipeline cannot
+        delay the compile that gates dispatch 1.  Any failure here
+        degrades to plain jit dispatch.
+        """
+        import jax
+
+        peeked = None
+        try:
+            state_avals = compile_cache.abstract_state(self.state)
+            valid_aval = None
+            if batch_spec is not None:
+                if k == 1:
+                    batch_avals = compile_cache.abstract_batch(
+                        batch_spec, self.mesh, self.rules
+                    )
+                else:
+                    stacked_spec = jax.tree_util.tree_map(
+                        lambda x: jax.ShapeDtypeStruct(
+                            (k,) + tuple(x.shape), x.dtype
+                        ),
+                        batch_spec,
+                    )
+                    batch_avals = compile_cache.abstract_batch(
+                        stacked_spec, self.mesh, self.rules, stacked=True
+                    )
+            else:
+                it = iter(source())
+                first = next(it, None)
+                peeked = _PeekedIterator(first, it)
+                if first is None:
+                    return None, peeked  # empty dataset: nothing to compile
+                if k == 1:
+                    batch_avals = compile_cache.abstract_batch(
+                        first, self.mesh, self.rules
+                    )
+                else:
+                    _, payload, first_valid = first
+                    if first_valid is None:
+                        # Ragged first window (per-batch example dims
+                        # differ): no stacked avals to compile against.
+                        return None, peeked
+                    batch_avals = compile_cache.abstract_batch(
+                        payload, self.mesh, self.rules, stacked=True
+                    )
+            if k > 1:
+                valid_aval = jax.ShapeDtypeStruct((k,), jnp.float32)
+
+            jobs = []
+            ctx = compile_cache.context_key(
+                mesh=self.mesh, rules=self.rules, donation=(0,),
+                steps_per_dispatch=k,
+            )
+            if k == 1:
+                aot = compile_cache.AotStep(self._train_step, "train_step")
+                jobs.append((aot, (state_avals, batch_avals), ctx))
+            else:
+                aot = compile_cache.AotStep(multi_step, "multi_step")
+                jobs.append(
+                    (aot, (state_avals, batch_avals, valid_aval), ctx)
+                )
+            if validation_data is not None:
+                eval_ctx = compile_cache.context_key(
+                    mesh=self.mesh, rules=self.rules, donation=(),
+                    steps_per_dispatch=1,
+                )
+
+                def eval_args():
+                    # Runs ON THE COMPILE WORKER, after the train-step
+                    # job: a slow validation pipeline's first batch must
+                    # not delay the compile that gates dispatch 1.
+                    val_batch = self._peek_one_batch(validation_data)
+                    if val_batch is None:
+                        return None
+                    return (state_avals, compile_cache.abstract_batch(
+                        val_batch, self.mesh, self.rules
+                    ))
+
+                jobs.append((
+                    compile_cache.AotStep(self._eval_step, "eval_step"),
+                    eval_args, eval_ctx,
+                ))
+            return compile_cache.start_compile_ahead(jobs), peeked
+        except Exception:  # noqa: BLE001 — compile-ahead is advisory
+            logger.warning(
+                "compile-ahead setup failed; falling back to jit dispatch",
+                exc_info=True,
+            )
+            return None, peeked
+
+    @staticmethod
+    def _peek_one_batch(dataset):
+        """One batch from a fresh iterator of a re-iterable dataset (the
+        fit() data contract), closing any worker it spawned."""
+        it = iter(dataset())
+        try:
+            return next(it, None)
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
 
     def _mesh_context(self):
         import contextlib

@@ -1,0 +1,2 @@
+"""Shared test helpers (importable because tests/ is on sys.path via the
+root conftest's directory)."""

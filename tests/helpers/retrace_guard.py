@@ -1,0 +1,50 @@
+"""Reusable retrace-count hook: prove a path compiles exactly once.
+
+Promoted from the inline counting-loss pattern in
+``tests/unit/test_pipeline_engine.py``: jax re-traces a function's Python
+body on every fresh compile, so counting loss-body executions OUTSIDE of
+concrete values distinguishes "cache hit" from "silent recompile" — the
+tier-1 guard for the fused-dispatch and tail-padding paths, where a
+regression quietly reintroduces per-window or per-tail compiles.
+
+Usage::
+
+    guard = RetraceGuard(loss_fn)
+    trainer = Trainer(guard.loss_fn, ...)
+    trainer.fit(..., steps_per_dispatch=4)
+    baseline = guard.traces          # >=1: the one compile happened
+    trainer.fit(...)                 # same shapes again
+    guard.assert_no_new_traces(baseline)
+
+The count is the number of Python executions of the wrapped body — a
+single jit compile may trace it several times (fwd + jvp + transpose),
+so assert EQUALITY across runs (or against a known-single-compile
+reference), never an absolute count of 1.
+"""
+
+from __future__ import annotations
+
+
+class RetraceGuard:
+    """Wraps a loss (or any traced) function, counting Python traces."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self.traces = 0
+
+        def counting(*args, **kwargs):
+            self.traces += 1
+            return fn(*args, **kwargs)
+
+        self.loss_fn = counting
+
+    def snapshot(self) -> int:
+        return self.traces
+
+    def assert_no_new_traces(self, since: int, context: str = "") -> None:
+        assert self.traces == since, (
+            f"unexpected retrace{' (' + context + ')' if context else ''}: "
+            f"{self.traces - since} new trace(s) of the wrapped body "
+            f"(was {since}, now {self.traces}) — a compiled executable "
+            "was NOT reused"
+        )

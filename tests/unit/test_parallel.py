@@ -397,5 +397,11 @@ class TestPlannerVirtualMultiSlice:
         assert plan.spec.size("dp") == 4
 
     def test_indivisible_slice_count_rejected(self):
-        with pytest.raises(ValueError, match="not divisible"):
+        # The error must name BOTH inputs and the expected divisibility —
+        # callers hit this from run()'s kwargs, far from plan_mesh itself.
+        with pytest.raises(
+            ValueError,
+            match=r"num_devices=8.*worker_count \+ 1 = 3.*worker_count=2"
+            r".*multiple of 3",
+        ):
             planner.plan_mesh(num_devices=8, worker_count=2)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
+from helpers.retrace_guard import RetraceGuard
 
 from cloud_tpu.monitoring import tracing
 from cloud_tpu.training import data, pipeline_io
@@ -175,6 +176,39 @@ class TestWindowing:
         gen.close()
         assert closed == [True]
 
+    def test_mixed_leaf_dims_stay_on_fused_path(self):
+        """Leaves with DIFFERENT leading dims within one batch (and
+        scalar leaves) are stackable as long as batches share the same
+        per-leaf shapes — only genuinely ragged windows degrade."""
+        batches = [
+            {
+                "x": np.ones((2, 4), np.float32),
+                "pos": np.arange(7, dtype=np.int32),
+                "scale": np.float32(1.0),
+            }
+            for _ in range(3)
+        ]
+        wins = list(pipeline_io.iter_windows(lambda: iter(batches), 2)())
+        assert [w[0] for w in wins] == [2, 1]
+        n, payload, valid = wins[0]
+        assert valid is not None  # fused, not ragged fallback
+        assert payload["x"].shape == (2, 2, 4)
+        assert payload["pos"].shape == (2, 7)
+        assert payload["scale"].shape == (2,)
+        n, payload, valid = wins[1]  # padded short tail
+        np.testing.assert_array_equal(valid, [1.0, 0.0])
+
+    def test_ragged_window_degrades_to_batch_list(self):
+        batches = [
+            {"x": np.ones((4, 3), np.float32)},
+            {"x": np.ones((2, 3), np.float32)},  # short final batch
+        ]
+        wins = list(pipeline_io.iter_windows(lambda: iter(batches), 2)())
+        assert len(wins) == 1
+        n, payload, valid = wins[0]
+        assert n == 2 and valid is None
+        assert [b["x"].shape for b in payload] == [(4, 3), (2, 3)]
+
     def test_stack_batches(self):
         batches = [{"x": np.full((2, 3), i)} for i in range(4)]
         stacked = pipeline_io.stack_batches(batches)
@@ -255,29 +289,70 @@ class TestMultiStep:
         """Tier-1 guard: the multi-step path must be compile-cached — a
         second window with identical shapes triggers NO retrace (a
         regression here silently reintroduces per-window compiles)."""
-        traces = {"n": 0}
-
-        def counting_loss(params, batch):
-            traces["n"] += 1
-            return _linear_loss(params, batch)
-
+        guard = RetraceGuard(_linear_loss)
         tx = optax.sgd(0.1)
         state = train_lib.create_sharded_state(
             jax.random.PRNGKey(0), lambda r: {"w": jnp.zeros((4, 2))},
             tx, mesh=None,
         )
         multi = train_lib.make_multi_step(
-            counting_loss, tx, steps_per_dispatch=2
+            guard.loss_fn, tx, steps_per_dispatch=2
         )
         super_batch = {
             "x": np.zeros((2, 2, 4), np.float32),
             "y": np.zeros((2, 2, 2), np.float32),
         }
         state, _ = multi(state, super_batch)
-        after_first = traces["n"]
+        after_first = guard.snapshot()
         assert after_first >= 1  # the scan traced the body (once per pass)
         state, _ = multi(state, super_batch)
-        assert traces["n"] == after_first  # second window: cache hit
+        guard.assert_no_new_traces(after_first, "second window")
+
+    def test_masked_tail_matches_sequential_single_steps(self):
+        """A padded tail window (3 real + 1 zero-padded step, masked)
+        produces the SAME state as 3 sequential single steps: the cond
+        skips the padded slot entirely — params, rng chain, and the step
+        counter pass through untouched."""
+        tx = optax.adam(0.05)
+        state = train_lib.create_sharded_state(
+            jax.random.PRNGKey(0), lambda r: {"w": jnp.zeros((4, 2))},
+            tx, mesh=None,
+        )
+        rng = np.random.default_rng(3)
+        batches = [
+            {
+                "x": rng.normal(size=(2, 4)).astype(np.float32),
+                "y": rng.normal(size=(2, 2)).astype(np.float32),
+            }
+            for _ in range(3)
+        ]
+        single = train_lib.make_train_step(_linear_loss, tx)
+        multi = train_lib.make_multi_step(
+            _linear_loss, tx, steps_per_dispatch=4
+        )
+        copy = lambda s: jax.tree_util.tree_map(jnp.copy, s)  # noqa: E731
+
+        seq_state = copy(state)
+        seq_losses = []
+        for b in batches:
+            seq_state, m = single(seq_state, b)
+            seq_losses.append(float(m["loss"]))
+
+        from cloud_tpu.parallel.sharding import pad_batch
+
+        stacked, valid = pad_batch(pipeline_io.stack_batches(batches), 4)
+        np.testing.assert_array_equal(valid, [1.0, 1.0, 1.0, 0.0])
+        fused_state, fused_metrics = multi(copy(state), stacked, valid)
+        assert int(fused_state.step) == 3  # padded slot did not count
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            ),
+            seq_state.params, fused_state.params,
+        )
+        np.testing.assert_allclose(
+            float(fused_metrics["loss"]), np.mean(seq_losses), rtol=1e-6
+        )
 
 
 class TestStepsPerDispatchTrainer:
@@ -286,35 +361,31 @@ class TestStepsPerDispatchTrainer:
         dispatches per epoch (4 steps each) with ONE compile across both
         epochs."""
         dispatches = {"n": 0}
-        traces = {"n": 0}
         real_make = train_lib.make_multi_step
 
         def counting_make(loss_fn, optimizer, **kwargs):
             fn = real_make(loss_fn, optimizer, **kwargs)
 
-            def wrapper(state, super_batch):
+            def wrapper(state, super_batch, valid=None):
                 dispatches["n"] += 1
-                return fn(state, super_batch)
+                return fn(state, super_batch, valid)
 
             return wrapper
 
         monkeypatch.setattr(train_lib, "make_multi_step", counting_make)
 
-        def counting_loss(params, batch):
-            traces["n"] += 1
-            return _linear_loss(params, batch)
-
-        trainer = _make_trainer(loss_fn=counting_loss)
+        guard = RetraceGuard(_linear_loss)
+        trainer = _make_trainer(loss_fn=guard.loss_fn)
         ds = _linear_problem()  # 8 batches of 2
         trainer.fit(ds, epochs=1, steps_per_dispatch=4)
         assert dispatches["n"] == 2
         assert int(trainer.state.step) == 8
-        after_first_epoch = traces["n"]
+        after_first_epoch = guard.snapshot()
         trainer.fit(ds, epochs=1, steps_per_dispatch=4)
         assert dispatches["n"] == 4
         assert int(trainer.state.step) == 16
         # Epoch 2 reused the cached executable: no new traces.
-        assert traces["n"] == after_first_epoch
+        guard.assert_no_new_traces(after_first_epoch, "epoch 2")
 
     def test_k1_vs_k4_identical_logs(self):
         """History and EarlyStopping observe identical epoch logs whether
@@ -368,11 +439,11 @@ class TestStepsPerDispatchTrainer:
         )
         assert steps_seen == [4, 8]
 
-    def test_tail_window_falls_back_to_single_steps(self):
+    def test_tail_window_pads_and_reuses_fused_executable(self):
         trainer = _make_trainer()
         history = trainer.fit(
             _linear_problem(), epochs=1, steps_per_dispatch=3
-        )  # 8 batches -> windows of 3 + 3 + tail 2
+        )  # 8 batches -> windows of 3 + 3 + padded tail 2
         assert int(trainer.state.step) == 8
         assert len(history.history["loss"]) == 1
 
