@@ -76,6 +76,15 @@ FUSED_K = 4
 FUSED_WARMUP = 1
 FUSED_MEASURE = 5
 
+#: Serving probe (cloud_tpu.serving): concurrent mixed-length requests
+#: through the dynamic batcher on the decode phase's SMALL model — the
+#: engine's tokens/sec + latency percentiles + occupancy next to the raw
+#: decode_tokens_per_sec isolates what batching/scheduling add or cost.
+SERVE_REQUESTS = 16
+SERVE_PROMPT_BUCKET = 128
+SERVE_NEW_TOKENS = 64
+SERVE_MAX_BATCH = 8
+
 METRIC = f"resnet50_cifar10_b{BATCH_SIZE}_train_steps_per_sec_per_chip"
 
 #: The last DRIVER-VERIFIED number (BENCH_r02.json, 2026-07-29, TPU v5e-1,
@@ -533,6 +542,65 @@ def _measure_decode(extras):
     extras["decode_config"] = f"SMALL b{b} prompt{t_prompt} new{new}"
 
 
+def _measure_serving(extras):
+    """Serving-engine probe: N concurrent mixed-length requests through
+    the dynamic batcher (``cloud_tpu.serving``), AOT-warmed, on the same
+    SMALL model as the decode phase.  Emits engine tokens/sec, request
+    latency percentiles, and mean batch occupancy — the three numbers
+    TPU serving economics hinge on (bucketed batching only pays while
+    occupancy stays high and the flush deadline doesn't dominate p99).
+    """
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils.benchmarking import decode_setup
+
+    import numpy as np
+
+    cfg, params, _, _ = decode_setup(
+        batch_size=SERVE_MAX_BATCH, prompt_len=SERVE_PROMPT_BUCKET
+    )
+    serve = ServeConfig(
+        max_new_tokens=SERVE_NEW_TOKENS,
+        prompt_buckets=(SERVE_PROMPT_BUCKET,),
+        batch_buckets=(1, SERVE_MAX_BATCH),
+        flush_deadline_s=0.05,
+        warmup=True,
+    )
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(
+        SERVE_PROMPT_BUCKET // 4, SERVE_PROMPT_BUCKET + 1, SERVE_REQUESTS
+    )
+    prompts = [
+        rng.integers(1, cfg.vocab_size, n).astype(np.int32) for n in lengths
+    ]
+    with ServingEngine(params, cfg, serve, mesh=None) as engine:
+        engine.wait_ready()
+        # One warm request absorbs any residual first-dispatch cost the
+        # AOT warmup didn't cover; the measured window is steady-state.
+        engine.submit(prompts[0]).result()
+        start = time.perf_counter()
+        futures = [engine.submit(p) for p in prompts]
+        results = [f.result() for f in futures]
+        wall = time.perf_counter() - start
+        stats = engine.stats()
+    latencies = sorted(r.latency_seconds for r in results)
+
+    def pct(q):
+        return latencies[min(len(latencies) - 1,
+                             int(q * (len(latencies) - 1) + 0.5))]
+
+    total_tokens = sum(r.num_generated for r in results)
+    extras["serve_decode_tokens_per_sec"] = round(total_tokens / wall, 1)
+    extras["serve_p50_latency_seconds"] = round(pct(0.5), 4)
+    extras["serve_p99_latency_seconds"] = round(pct(0.99), 4)
+    extras["serve_mean_batch_occupancy"] = round(
+        stats["mean_batch_occupancy"], 3
+    )
+    extras["serve_config"] = (
+        f"SMALL bucket{SERVE_PROMPT_BUCKET} new{SERVE_NEW_TOKENS} "
+        f"maxbatch{SERVE_MAX_BATCH} n{SERVE_REQUESTS}"
+    )
+
+
 def _child_main() -> int:
     """Headline first; every phase prints its own salvageable JSON line."""
     # Span tracing on for the whole child: compile vs measure wall-clock
@@ -586,6 +654,7 @@ def _child_main() -> int:
         (_measure_bert, "bert"),
         (_measure_resnet224, "resnet224"),
         (_measure_decode, "decode"),
+        (_measure_serving, "serving"),
     ):
         phase_extras = {"peak_bf16_tflops": extras.get("peak_bf16_tflops")}
         try:

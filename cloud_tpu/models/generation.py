@@ -293,66 +293,19 @@ def _prefill(params, prompt_tokens, prompt_lens, config, s, rules, mesh,
     return cache, logits0
 
 
-def generate(
-    params,
-    prompt_tokens: jnp.ndarray,
-    prompt_lens: jnp.ndarray,
-    config: transformer.TransformerConfig,
-    *,
-    max_new_tokens: int,
-    sample: SampleConfig = SampleConfig(temperature=0.0),
-    rng: Optional[jax.Array] = None,
-    rules: ShardingRules = DEFAULT_RULES,
-    mesh=None,
-    kv_quant: bool = False,
-) -> Dict[str, Any]:
-    """Generate ``max_new_tokens`` continuations for a batch of prompts.
+def _decode_tokens(params, cache, logits0, prompt_lens, config, *,
+                   max_new_tokens, sample, rng, rules, mesh):
+    """The scan-decode half of :func:`generate`: from a filled KV cache
+    and the prefill's next-token logits to ``(tokens, num_generated)``.
 
-    Args:
-      prompt_tokens: [B, T_prompt] left-aligned token ids (rows shorter
-        than T_prompt padded arbitrarily on the right).
-      prompt_lens: [B] actual prompt lengths (1 <= len <= T_prompt).
-      max_new_tokens: static decode trip count.
-      sample: sampling configuration; default greedy.
-      rng: PRNG key (required unless greedy).
-      kv_quant: store the KV cache int8 with per-(position, head)
-        scales (_init_cache docstring) — the long-context decode
-        bandwidth knob; combine with int8 weights
-        (models/quantization.py) for fully-narrow decoding.
-
-    Returns dict with:
-      ``tokens``: [B, max_new_tokens] generated ids — eos included where
-        sampled, pad in every slot after it,
-      ``sequences``: [B, T_prompt + max_new_tokens] prompt + generation
-        stitched at each row's true length (pad elsewhere),
-      ``num_generated``: [B] count of generated tokens including the eos.
+    Split out so the serving engine (``cloud_tpu.serving``) can dispatch
+    prefill and decode as separately-compiled — and separately-spanned —
+    programs; :func:`generate` composes the two plus the sequence
+    stitching.  ``tokens`` is [B, max_new_tokens] (eos included where
+    sampled, pad in every slot after it); ``num_generated`` counts the
+    generated tokens per row, eos included.
     """
-    mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
-    _check_inference_supported(config, rules, mesh, "generation")
-    if sample.temperature != 0.0 and rng is None:
-        raise ValueError("non-greedy sampling needs an rng key")
-    rng = jax.random.PRNGKey(0) if rng is None else rng
-
-    if max_new_tokens < 0:
-        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
-    b, t_prompt = prompt_tokens.shape
-    # Documented domain is 1 <= len <= T_prompt; out-of-range lengths
-    # would make last_idx negative (gather/scatter wrap silently under
-    # jit) — clamp rather than corrupt.
-    prompt_lens = jnp.clip(prompt_lens.astype(jnp.int32), 1, t_prompt)
-    if max_new_tokens == 0:
-        cols = jnp.arange(t_prompt)[None, :]
-        return {
-            "tokens": jnp.zeros((b, 0), jnp.int32),
-            "sequences": jnp.where(
-                cols < prompt_lens[:, None], prompt_tokens.astype(jnp.int32),
-                jnp.int32(sample.pad_id),
-            ),
-            "num_generated": jnp.zeros((b,), jnp.int32),
-        }
-    s = t_prompt + max_new_tokens
-    cache, logits0 = _prefill(params, prompt_tokens, prompt_lens, config,
-                              s, rules, mesh, kv_quant=kv_quant)
+    b = logits0.shape[0]
     rng, step_rng = jax.random.split(rng)
     track_seen = sample.repetition_penalty != 1.0
     # Static gate: the allow-eos masking only enters the compiled loop
@@ -429,6 +382,74 @@ def generate(
         tokens = jnp.concatenate([emitted.T, final_emit[:, None]], axis=1)
     else:
         tokens = final_emit[:, None]
+    return tokens, final_len - prompt_lens
+
+
+def generate(
+    params,
+    prompt_tokens: jnp.ndarray,
+    prompt_lens: jnp.ndarray,
+    config: transformer.TransformerConfig,
+    *,
+    max_new_tokens: int,
+    sample: SampleConfig = SampleConfig(temperature=0.0),
+    rng: Optional[jax.Array] = None,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+    kv_quant: bool = False,
+) -> Dict[str, Any]:
+    """Generate ``max_new_tokens`` continuations for a batch of prompts.
+
+    Args:
+      prompt_tokens: [B, T_prompt] left-aligned token ids (rows shorter
+        than T_prompt padded arbitrarily on the right).
+      prompt_lens: [B] actual prompt lengths (1 <= len <= T_prompt).
+      max_new_tokens: static decode trip count.
+      sample: sampling configuration; default greedy.
+      rng: PRNG key (required unless greedy).
+      kv_quant: store the KV cache int8 with per-(position, head)
+        scales (_init_cache docstring) — the long-context decode
+        bandwidth knob; combine with int8 weights
+        (models/quantization.py) for fully-narrow decoding.
+
+    Returns dict with:
+      ``tokens``: [B, max_new_tokens] generated ids — eos included where
+        sampled, pad in every slot after it,
+      ``sequences``: [B, T_prompt + max_new_tokens] prompt + generation
+        stitched at each row's true length (pad elsewhere),
+      ``num_generated``: [B] count of generated tokens including the eos.
+    """
+    mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
+    _check_inference_supported(config, rules, mesh, "generation")
+    if sample.temperature != 0.0 and rng is None:
+        raise ValueError("non-greedy sampling needs an rng key")
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    b, t_prompt = prompt_tokens.shape
+    # Documented domain is 1 <= len <= T_prompt; out-of-range lengths
+    # would make last_idx negative (gather/scatter wrap silently under
+    # jit) — clamp rather than corrupt.
+    prompt_lens = jnp.clip(prompt_lens.astype(jnp.int32), 1, t_prompt)
+    if max_new_tokens == 0:
+        cols = jnp.arange(t_prompt)[None, :]
+        return {
+            "tokens": jnp.zeros((b, 0), jnp.int32),
+            "sequences": jnp.where(
+                cols < prompt_lens[:, None], prompt_tokens.astype(jnp.int32),
+                jnp.int32(sample.pad_id),
+            ),
+            "num_generated": jnp.zeros((b,), jnp.int32),
+        }
+    s = t_prompt + max_new_tokens
+    cache, logits0 = _prefill(params, prompt_tokens, prompt_lens, config,
+                              s, rules, mesh, kv_quant=kv_quant)
+    tokens, num_generated = _decode_tokens(
+        params, cache, logits0, prompt_lens, config,
+        max_new_tokens=max_new_tokens, sample=sample, rng=rng,
+        rules=rules, mesh=mesh,
+    )
 
     # Stitch prompt + generation at each row's true offset.  ``tokens`` is
     # already pad-masked past the eos, so the scatter needs no validity
@@ -449,8 +470,78 @@ def generate(
     return {
         "tokens": tokens,
         "sequences": sequences,
-        "num_generated": final_len - prompt_lens,
+        "num_generated": num_generated,
     }
+
+
+def prefill_program(
+    params,
+    prompt_tokens: jnp.ndarray,
+    prompt_lens: jnp.ndarray,
+    config: transformer.TransformerConfig,
+    *,
+    max_new_tokens: int,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+    kv_quant: bool = False,
+):
+    """Batched serving entry, half 1: prompt prefill as its own program.
+
+    Jit-friendly (no host-side validation — the serving engine runs
+    :func:`check_inference_supported` once at startup): accepts a
+    pre-padded prompt bucket [B, bucket_len] with per-row true lengths,
+    returns ``(cache, logits0)`` sized for ``bucket_len +
+    max_new_tokens`` decode positions.  Feed both to
+    :func:`decode_program`; the split lets ``cloud_tpu.serving`` compile,
+    dispatch, and span prefill and decode independently (their cost
+    scales differently: prefill with prompt length, decode with
+    max_new_tokens x batch).
+    """
+    t_prompt = prompt_tokens.shape[1]
+    prompt_lens = jnp.clip(prompt_lens.astype(jnp.int32), 1, t_prompt)
+    return _prefill(params, prompt_tokens, prompt_lens, config,
+                    t_prompt + max_new_tokens, rules, mesh,
+                    kv_quant=kv_quant)
+
+
+def decode_program(
+    params,
+    cache,
+    logits0: jnp.ndarray,
+    prompt_lens: jnp.ndarray,
+    config: transformer.TransformerConfig,
+    *,
+    max_new_tokens: int,
+    sample: SampleConfig = SampleConfig(temperature=0.0),
+    rng: Optional[jax.Array] = None,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+) -> Dict[str, Any]:
+    """Batched serving entry, half 2: scan-decode from a prefilled cache.
+
+    ``max_new_tokens`` must match the value the cache was prefilled for
+    (the cache's trailing positions are the decode slots).  Returns
+    ``tokens`` [B, max_new_tokens] and the per-row generated lengths
+    ``num_generated`` — what the serving engine demultiplexes back onto
+    individual requests.  ``rng`` is always accepted (ignored under
+    greedy) so one compiled signature serves every sampling config.
+    """
+    t_prompt = cache["k"].shape[2] - max_new_tokens
+    prompt_lens = jnp.clip(prompt_lens.astype(jnp.int32), 1, t_prompt)
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    tokens, num_generated = _decode_tokens(
+        params, cache, logits0, prompt_lens, config,
+        max_new_tokens=max_new_tokens, sample=sample, rng=rng,
+        rules=rules, mesh=mesh,
+    )
+    return {"tokens": tokens, "num_generated": num_generated}
+
+
+def check_inference_supported(config, rules, mesh, what: str = "inference"):
+    """Public guard for callers that bypass :func:`generate`'s own checks
+    (the serving engine validates once at startup, then dispatches the
+    jit-friendly :func:`prefill_program`/:func:`decode_program` pair)."""
+    _check_inference_supported(config, rules, mesh, what)
 
 
 def _check_inference_supported(config, rules, mesh, what: str):

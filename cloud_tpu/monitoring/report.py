@@ -5,6 +5,14 @@ per-span-name table (count, total, mean, p50, max, % of wall) from a
 Chrome trace-event file written by ``tracing.dump_timeline``.  The same
 summarization is importable as :class:`TraceReport` for programmatic use
 (bench.py ships the equivalent aggregates in its BENCH json).
+
+When the timeline contains serving spans (``serve/*`` — the
+``cloud_tpu.serving`` engine), a dedicated breakdown follows the main
+table: queue wait vs batch formation vs prefill vs decode, each as a
+percentage of total serve-span time, so "requests are slow" resolves
+one level deeper — waiting for a batch slot (raise ``max_queue`` /
+shrink ``flush_deadline_s``) vs paying compute (shrink buckets, raise
+occupancy) — without leaving the CLI.
 """
 
 from __future__ import annotations
@@ -68,10 +76,53 @@ class TraceReport:
         rows.sort(key=lambda r: r["total_s"], reverse=True)
         return rows
 
+    #: The serving phases, in request order (the ``cloud_tpu.serving``
+    #: engine's span names); anything else under ``serve/`` rides along.
+    _SERVE_ORDER = (
+        "serve/queue_wait", "serve/batch_form", "serve/prefill",
+        "serve/decode",
+    )
+
+    def serving_rows(self, rows: Optional[List[Dict[str, float]]] = None
+                     ) -> List[Dict[str, float]]:
+        """The ``serve/*`` spans as a queue-wait vs prefill vs decode
+        breakdown: same aggregates as :meth:`rows`, but ``pct_serve`` is
+        each phase's share of total serve-span time (the phases are
+        sequential per request, so shares read as "where a request's
+        latency went") and rows come in request order, not sorted by
+        cost.  Empty when the timeline has no serving spans.  Pass
+        precomputed :meth:`rows` output to skip re-aggregating a large
+        timeline.
+        """
+        if rows is None:
+            rows = self.rows()
+        rows = [dict(r) for r in rows if r["name"].startswith("serve/")]
+        total = sum(r["total_s"] for r in rows)
+        order = {name: i for i, name in enumerate(self._SERVE_ORDER)}
+        rows.sort(key=lambda r: (order.get(r["name"], len(order)),
+                                 r["name"]))
+        for row in rows:
+            row["pct_serve"] = 100.0 * row["total_s"] / total if total else 0.0
+        return rows
+
+    @staticmethod
+    def _render_table(rows, header) -> List[str]:
+        table = [header] + rows
+        widths = [max(len(row[i]) for row in table) for i in range(len(header))]
+        lines = []
+        for i, row in enumerate(table):
+            lines.append("  ".join(
+                cell.ljust(w) if j == 0 else cell.rjust(w)
+                for j, (cell, w) in enumerate(zip(row, widths))
+            ))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+        return lines
+
     def render(self) -> str:
         rows = self.rows()
         header = ("span", "count", "total", "mean", "p50", "max", "% wall")
-        table = [header] + [
+        lines = self._render_table([
             (
                 r["name"],
                 str(r["count"]),
@@ -82,16 +133,25 @@ class TraceReport:
                 f"{r['pct_wall']:.1f}",
             )
             for r in rows
-        ]
-        widths = [max(len(row[i]) for row in table) for i in range(len(header))]
-        lines = []
-        for i, row in enumerate(table):
-            lines.append("  ".join(
-                cell.ljust(w) if j == 0 else cell.rjust(w)
-                for j, (cell, w) in enumerate(zip(row, widths))
-            ))
-            if i == 0:
-                lines.append("  ".join("-" * w for w in widths))
+        ], header)
+        serve_rows = self.serving_rows(rows)
+        if serve_rows:
+            lines.append("")
+            lines.append("serving breakdown (per-request phases, % of "
+                         "serve time):")
+            lines.extend(self._render_table([
+                (
+                    r["name"],
+                    str(r["count"]),
+                    _fmt_s(r["total_s"]),
+                    _fmt_s(r["mean_s"]),
+                    _fmt_s(r["p50_s"]),
+                    _fmt_s(r["max_s"]),
+                    f"{r['pct_serve']:.1f}",
+                )
+                for r in serve_rows
+            ], ("phase", "count", "total", "mean", "p50", "max",
+                "% serve")))
         lines.append("")
         lines.append(
             f"{len(self.events)} spans over {_fmt_s(self.wall_seconds())} "

@@ -310,6 +310,41 @@ def span(name: str, **attributes: Any):
     return Span(name, collector, attributes or None)
 
 
+def record_span(name: str, start: float, end: float,
+                **attributes: Any) -> None:
+    """Record an already-measured interval as a finished span.
+
+    For phases whose start and end live on different threads — a serving
+    request's queue wait begins at ``submit()`` on the caller's thread
+    and ends when the scheduler folds it into a batch — where a context
+    manager cannot wrap the interval.  ``start``/``end`` are
+    ``time.perf_counter()`` readings; the span lands in the timeline,
+    aggregates, and the ``span/<name>`` metrics distribution exactly like
+    a context-manager span (no parent nesting, since no thread "owns"
+    it).  No-op while tracing is disabled, same as :func:`span`.
+    """
+    collector = _collector
+    if collector is None:
+        return
+    duration = max(0.0, end - start)
+    args: Dict[str, Any] = {"span_id": collector.next_span_id(),
+                            "parent_id": 0}
+    args.update(attributes)
+    collector.add(
+        {
+            "name": name,
+            "ph": "X",
+            "ts": (start - collector.epoch) * 1e6,
+            "dur": duration * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": args,
+        },
+        duration,
+    )
+    metrics.distribution_record(f"span/{name}", duration)
+
+
 def traced(fn=None, *, name: Optional[str] = None):
     """Decorator form: ``@tracing.traced`` or ``@tracing.traced(name=...)``.
 

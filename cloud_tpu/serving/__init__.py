@@ -1,0 +1,27 @@
+"""In-process TPU serving: dynamic-batched inference on the generation path.
+
+The training side got its occupancy engineering in PRs 2-3 (prefetch,
+fused dispatch, compile-ahead); this package is the inference
+counterpart — a request queue + scheduler that drives
+``models.generation``'s prefill/decode programs at high batch occupancy
+while individual callers see a simple future-per-request API.  See
+``docs/serving.md`` and :mod:`cloud_tpu.serving.engine`.
+"""
+
+from cloud_tpu.serving.engine import (
+    EngineClosedError,
+    QueueFullError,
+    ServeConfig,
+    ServeResult,
+    ServingEngine,
+    SERVE_SCHEDULER_THREAD_NAME,
+)
+
+__all__ = [
+    "EngineClosedError",
+    "QueueFullError",
+    "ServeConfig",
+    "ServeResult",
+    "ServingEngine",
+    "SERVE_SCHEDULER_THREAD_NAME",
+]

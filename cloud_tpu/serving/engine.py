@@ -1,0 +1,624 @@
+"""Dynamic-batched serving engine over the generation path.
+
+``models.generation`` can decode a *batch* of prompts as one compiled
+program, but traffic arrives one request at a time; serving economics on
+TPU hinge on the gap between those two facts (batched decode occupancy
+amortizes the weight reads every decode step re-pays — arxiv 2605.25645,
+arxiv 2309.08918).  :class:`ServingEngine` closes the gap in-process:
+
+* **Dynamic batching** — ``submit()`` enqueues a request and returns a
+  ``concurrent.futures.Future``; a scheduler thread groups waiting
+  requests by *prompt-length bucket*, pads each group to its bucket
+  shape, and dispatches prefill + scan-decode as two compiled programs
+  (``generation.prefill_program`` / ``generation.decode_program``),
+  demultiplexing per-row results back onto the futures.  A batch forms
+  when a bucket fills to the largest batch bucket or when its oldest
+  request has waited ``flush_deadline_s`` — a lone request is never
+  stranded behind an unfillable batch.
+* **Bucketed AOT warmup** — shapes are quantized to a static
+  ``(bucket_len, batch_size)`` grid, so the full set of executables the
+  engine can ever dispatch is enumerable; ``warmup=True`` pre-compiles
+  the grid through ``training.compile_cache`` (the same AOT registry +
+  background worker the trainer's compile-ahead uses) at engine start,
+  making first-request latency an engineered quantity like PR 3 did for
+  first-step latency.
+* **Admission control** — the waiting set is bounded by ``max_queue``;
+  ``admission="block"`` makes ``submit`` wait for space,
+  ``admission="reject"`` raises :class:`QueueFullError` (typed, so a
+  caller can shed load).  ``close()`` drains gracefully: admitted
+  requests complete, later submits raise :class:`EngineClosedError`, and
+  no scheduler/warmup thread survives (same thread-hygiene contract as
+  ``training.pipeline_io``).
+* **Observability** — ``serve/queue_wait`` (recorded cross-thread via
+  ``tracing.record_span``), ``serve/batch_form``, ``serve/prefill`` and
+  ``serve/decode`` spans; ``serve/qps`` and ``serve/tokens_per_sec``
+  windowed-rate gauges, a ``serve/batch_occupancy`` gauge and a
+  ``serve/latency_seconds`` distribution.  ``python -m
+  cloud_tpu.monitoring.report`` renders the serve spans as a dedicated
+  queue-wait vs prefill vs decode breakdown.
+
+Greedy parity is the correctness contract: for any mix of prompt
+lengths, a request's tokens are identical to a direct per-request
+``generation.generate`` call (padding rows and bucket tails are masked
+out of attention, and greedy decode is prefix-consistent, so per-request
+``max_new_tokens`` is served by trimming the engine-wide decode length).
+Proven in tests/unit/test_serving.py and scripts/check_serving.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cloud_tpu.monitoring import metrics, tracing
+
+logger = logging.getLogger(__name__)
+
+#: Scheduler-thread name (prefix match in tests' thread-leak guards).
+SERVE_SCHEDULER_THREAD_NAME = "cloud-tpu-serve-scheduler"
+
+
+class QueueFullError(RuntimeError):
+    """Typed rejection under ``admission="reject"``: the waiting set is at
+    ``max_queue`` — shed the request or retry with backoff."""
+
+
+class EngineClosedError(RuntimeError):
+    """The engine is closed (or closing): the request was not admitted."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine knobs (all static — they define the compiled-program grid).
+
+    ``prompt_buckets`` are the padded prompt lengths the engine compiles
+    for (a request lands in the smallest bucket that fits it);
+    ``batch_buckets`` are the batch sizes (a formed group pads up to the
+    smallest batch bucket that fits, so occupancy is explicit: 3 requests
+    in a bucket-4 dispatch is 75%).  The compiled grid is their cross
+    product x {prefill, decode}.  ``flush_deadline_s`` bounds how long a
+    request may wait for co-batching once it is first in line;
+    ``max_queue``/``admission`` are the backpressure contract
+    (module docstring).
+    """
+
+    max_new_tokens: int = 32
+    prompt_buckets: Tuple[int, ...] = (32, 128, 512)
+    batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
+    flush_deadline_s: float = 0.01
+    max_queue: int = 256
+    admission: str = "block"
+    #: Sampling config shared by every request (static: it specializes
+    #: the compiled decode program).  Default greedy.
+    sample: "SampleConfig" = None  # type: ignore[assignment]
+    kv_quant: bool = False
+    #: Pre-compile the whole (bucket_len, batch_size) grid at start on a
+    #: background worker (``training.compile_cache``).
+    warmup: bool = False
+    #: Seed for the engine-owned sampling rng chain (non-greedy configs).
+    seed: int = 0
+
+    def __post_init__(self):
+        from cloud_tpu.models.generation import SampleConfig
+
+        if self.sample is None:
+            object.__setattr__(self, "sample",
+                               SampleConfig(temperature=0.0))
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+            )
+        for name in ("prompt_buckets", "batch_buckets"):
+            buckets = tuple(getattr(self, name))
+            object.__setattr__(self, name, buckets)
+            if not buckets or any(b < 1 for b in buckets):
+                raise ValueError(f"{name} must be non-empty and positive")
+            if list(buckets) != sorted(set(buckets)):
+                raise ValueError(
+                    f"{name} must be strictly increasing, got {buckets}"
+                )
+        if self.admission not in ("block", "reject"):
+            raise ValueError(
+                f"admission must be 'block' or 'reject', "
+                f"got {self.admission!r}"
+            )
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.flush_deadline_s < 0:
+            raise ValueError("flush_deadline_s must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeResult:
+    """One resolved request.
+
+    ``tokens`` is the request's generated row, length =
+    its ``max_new_tokens`` (eos included where sampled, pad after it) —
+    byte-identical to ``generation.generate``'s row for the same prompt.
+    ``num_generated`` counts real tokens (eos included).  The batch
+    fields record how the request was served (occupancy debugging).
+    """
+
+    tokens: np.ndarray
+    num_generated: int
+    bucket_len: int
+    batch_size: int
+    latency_seconds: float
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: np.ndarray
+    prompt_len: int
+    max_new_tokens: int
+    bucket_len: int
+    future: Future
+    submitted: float  # perf_counter
+
+
+class _Cell:
+    """The compiled-program pair for one (bucket_len, batch_size) point.
+
+    ``AotStep`` wrappers (training.compile_cache): a warmed cell
+    dispatches the pre-compiled executable; an un-warmed (or mismatched)
+    one falls back to the jitted function, which compiles on first use —
+    warmup makes the engine fast, never wrong.
+    """
+
+    def __init__(self, engine: "ServingEngine", bucket_len: int,
+                 batch_size: int):
+        import functools
+
+        import jax
+
+        from cloud_tpu.models import generation
+        from cloud_tpu.training import compile_cache
+
+        cfg = engine.serve_config
+        self.bucket_len = bucket_len
+        self.batch_size = batch_size
+        prefill_fn = jax.jit(functools.partial(
+            generation.prefill_program,
+            config=engine.config, max_new_tokens=cfg.max_new_tokens,
+            rules=engine.rules, mesh=engine.mesh, kv_quant=cfg.kv_quant,
+        ))
+
+        # Positional-arg wrapper: AotStep (and AOT-compiled executables)
+        # dispatch positionally, but decode_program's rng is keyword-only.
+        def decode_positional(params, cache, logits0, prompt_lens, rng):
+            return generation.decode_program(
+                params, cache, logits0, prompt_lens, engine.config,
+                max_new_tokens=cfg.max_new_tokens, sample=cfg.sample,
+                rng=rng, rules=engine.rules, mesh=engine.mesh,
+            )
+
+        decode_fn = jax.jit(decode_positional)
+        tag = f"L{bucket_len}_B{batch_size}"
+        self.prefill = compile_cache.AotStep(
+            prefill_fn, label=f"serve/prefill_{tag}"
+        )
+        self.decode = compile_cache.AotStep(
+            decode_fn, label=f"serve/decode_{tag}"
+        )
+
+
+class ServingEngine:
+    """In-process dynamic-batching server over ``generation`` (module
+    docstring).  Construct, ``submit()`` concurrently from any thread,
+    ``close()`` when done (or use as a context manager)."""
+
+    def __init__(
+        self,
+        params,
+        config,
+        serve_config: Optional[ServeConfig] = None,
+        *,
+        rules=None,
+        mesh=None,
+        start: bool = True,
+    ):
+        import jax
+
+        from cloud_tpu.models import generation
+        from cloud_tpu.parallel import mesh as mesh_lib
+        from cloud_tpu.parallel.sharding import DEFAULT_RULES
+
+        self.params = params
+        self.config = config
+        self.serve_config = serve_config or ServeConfig()
+        self.rules = rules if rules is not None else DEFAULT_RULES
+        self.mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
+        generation.check_inference_supported(
+            config, self.rules, self.mesh, "serving"
+        )
+        # Engine-owned rng chain: split per batch (carried but
+        # unobservable under greedy — one decode signature either way).
+        self._rng = jax.random.PRNGKey(self.serve_config.seed)
+
+        self._cond = threading.Condition()
+        #: bucket_len -> FIFO of waiting _Requests (guarded by _cond).
+        self._pending: Dict[int, collections.deque] = {}
+        self._waiting = 0
+        self._closed = False
+        self._draining = True
+        self._thread: Optional[threading.Thread] = None
+        self._cells: Dict[Tuple[int, int], _Cell] = {}
+        self._warmup_plan = None
+
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            "requests": 0, "completed": 0, "failed": 0, "rejected": 0,
+            "batches": 0, "slots": 0, "real_rows": 0,
+            "generated_tokens": 0,
+        }
+        self._qps = metrics.WindowedRate("serve/qps", window=16)
+        self._tokens_rate = metrics.WindowedRate(
+            "serve/tokens_per_sec", window=256
+        )
+
+        if self.serve_config.warmup:
+            self._start_warmup()
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServingEngine":
+        """Launch the scheduler thread (idempotent)."""
+        with self._cond:
+            if self._closed:
+                raise EngineClosedError("engine already closed")
+            if self._thread is not None:
+                return self
+            self._thread = threading.Thread(
+                target=self._scheduler_loop, daemon=True,
+                name=SERVE_SCHEDULER_THREAD_NAME,
+            )
+            self._thread.start()
+        return self
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None
+              ) -> None:
+        """Stop the engine: no more admissions, resolve what is owed.
+
+        ``drain=True`` (default) serves every already-admitted request
+        before the scheduler exits; ``drain=False`` fails waiting
+        requests with :class:`EngineClosedError` immediately.  Joins the
+        scheduler and any warmup worker — after ``close()`` returns, the
+        engine owns zero live threads.
+        """
+        with self._cond:
+            self._closed = True
+            self._draining = drain
+            # A never-started engine has no scheduler to drain through:
+            # fail what waits rather than strand the futures forever.
+            if not drain or self._thread is None:
+                self._fail_pending_locked(
+                    EngineClosedError("engine closed before dispatch")
+                )
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+        if self._warmup_plan is not None:
+            self._warmup_plan.wait(timeout=timeout)
+        now = time.perf_counter()
+        self._qps.flush(now)
+        self._tokens_rate.flush(now)
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # -- submission --------------------------------------------------------
+
+    @property
+    def max_prompt_len(self) -> int:
+        return self.serve_config.prompt_buckets[-1]
+
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None
+               ) -> Future:
+        """Enqueue one prompt; returns a Future of :class:`ServeResult`.
+
+        ``prompt`` is a 1-D int sequence (length 1 ..
+        ``prompt_buckets[-1]``).  ``max_new_tokens`` may be below the
+        engine-wide ``serve_config.max_new_tokens`` (the row is trimmed —
+        greedy decode is prefix-consistent, so this equals a shorter
+        direct run); above it is an error.  Thread-safe; blocks or
+        raises :class:`QueueFullError` at ``max_queue`` per the
+        admission policy.
+        """
+        cfg = self.serve_config
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1:
+            raise ValueError(
+                f"prompt must be 1-D token ids, got shape {prompt.shape}"
+            )
+        n = int(prompt.shape[0])
+        if not 1 <= n <= self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {n} outside [1, {self.max_prompt_len}] "
+                f"(prompt_buckets={cfg.prompt_buckets})"
+            )
+        m = cfg.max_new_tokens if max_new_tokens is None else int(
+            max_new_tokens)
+        if not 1 <= m <= cfg.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {m} outside [1, {cfg.max_new_tokens}]"
+            )
+        bucket_len = next(b for b in cfg.prompt_buckets if b >= n)
+        request = _Request(
+            prompt=prompt, prompt_len=n, max_new_tokens=m,
+            bucket_len=bucket_len, future=Future(),
+            submitted=time.perf_counter(),
+        )
+        with self._cond:
+            if self._closed:
+                raise EngineClosedError("engine is closed")
+            if self._waiting >= cfg.max_queue:
+                if cfg.admission == "reject":
+                    with self._stats_lock:
+                        self._stats["rejected"] += 1
+                    metrics.counter_inc("serve/rejected")
+                    raise QueueFullError(
+                        f"serving queue full ({cfg.max_queue} waiting); "
+                        "retry with backoff or raise max_queue"
+                    )
+                while self._waiting >= cfg.max_queue and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    raise EngineClosedError("engine closed while blocked "
+                                            "on admission")
+            self._pending.setdefault(
+                bucket_len, collections.deque()
+            ).append(request)
+            self._waiting += 1
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._stats["requests"] += 1
+        metrics.counter_inc("serve/requests")
+        return request.future
+
+    # -- warmup ------------------------------------------------------------
+
+    def _start_warmup(self) -> None:
+        """Queue AOT compiles for the whole grid on the compile-ahead
+        worker (one background thread, in grid order — smallest programs
+        first so early traffic warms soonest)."""
+        import jax
+
+        from cloud_tpu.training import compile_cache
+
+        cfg = self.serve_config
+        params_avals = compile_cache.abstract_state(self.params)
+        context = compile_cache.context_key(mesh=self.mesh, rules=self.rules)
+        rng_aval = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
+        jobs = []
+        for bucket_len in cfg.prompt_buckets:
+            for batch_size in cfg.batch_buckets:
+                cell = self._cell(bucket_len, batch_size)
+                tok_aval = jax.ShapeDtypeStruct(
+                    (batch_size, bucket_len), np.int32
+                )
+                lens_aval = jax.ShapeDtypeStruct((batch_size,), np.int32)
+                prefill_args = (params_avals, tok_aval, lens_aval)
+                jobs.append((cell.prefill, prefill_args, context))
+
+                def decode_args(cell=cell, prefill_args=prefill_args):
+                    # Resolved on the worker right before the decode
+                    # compile: the cache/logits avals come from an
+                    # eval_shape of the prefill program (pure tracing).
+                    cache_aval, logits_aval = jax.eval_shape(
+                        cell.prefill.jitted, *prefill_args
+                    )
+                    return (params_avals, cache_aval, logits_aval,
+                            prefill_args[2], rng_aval)
+
+                jobs.append((cell.decode, decode_args, context))
+        self._warmup_plan = compile_cache.start_compile_ahead(jobs)
+
+    def wait_ready(self, timeout: Optional[float] = None) -> None:
+        """Block until the warmup grid has finished compiling (no-op
+        without ``warmup=True``; compile failures were logged and those
+        cells fall back to jit — see ``compile_cache.CompileAhead``)."""
+        if self._warmup_plan is not None:
+            self._warmup_plan.wait(timeout=timeout)
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _cell(self, bucket_len: int, batch_size: int) -> _Cell:
+        key = (bucket_len, batch_size)
+        cell = self._cells.get(key)
+        if cell is None:
+            cell = self._cells[key] = _Cell(self, bucket_len, batch_size)
+        return cell
+
+    def _fail_pending_locked(self, exc: BaseException) -> None:
+        failed = 0
+        for queue_ in self._pending.values():
+            while queue_:
+                request = queue_.popleft()
+                self._waiting -= 1
+                failed += 1
+                try:
+                    request.future.set_exception(exc)
+                except InvalidStateError:  # pragma: no cover - cancelled
+                    pass
+        if failed:
+            with self._stats_lock:
+                self._stats["failed"] += failed
+
+    def _pop_batch_locked(self, now: float) -> Optional[List[_Request]]:
+        """The batch-formation policy (caller holds the lock).
+
+        Priority: (1) the bucket whose HEAD request has waited past
+        ``flush_deadline_s``, oldest head first — the deadline is a real
+        bound, never preempted by other buckets' saturation (under
+        sustained traffic the saturated bucket's own head is expired
+        too, so oldest-first degenerates to FIFO across buckets and a
+        minority bucket cannot starve); (2) any bucket with a full
+        max-batch — no deadline pressure, so take the occupancy win;
+        (3) when draining a closed engine, anything left.  Whichever
+        bucket wins, up to a full max-batch is taken from it.
+        """
+        max_batch = self.serve_config.batch_buckets[-1]
+        chosen = None
+        for queue_ in self._pending.values():
+            if not queue_:
+                continue
+            head = queue_[0]
+            if now - head.submitted >= self.serve_config.flush_deadline_s:
+                if chosen is None or head.submitted < chosen[0].submitted:
+                    chosen = queue_
+        if chosen is None:
+            for queue_ in self._pending.values():
+                if len(queue_) >= max_batch:
+                    chosen = queue_
+                    break
+        if chosen is None and self._closed and self._draining:
+            chosen = next(
+                (q for q in self._pending.values() if q), None
+            )
+        if chosen is None:
+            return None
+        batch = []
+        while chosen and len(batch) < max_batch:
+            batch.append(chosen.popleft())
+        return batch
+
+    def _earliest_deadline_locked(self) -> Optional[float]:
+        heads = [q[0].submitted for q in self._pending.values() if q]
+        if not heads:
+            return None
+        return min(heads) + self.serve_config.flush_deadline_s
+
+    def _scheduler_loop(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    while True:
+                        now = time.perf_counter()
+                        batch = self._pop_batch_locked(now)
+                        if batch is not None:
+                            self._waiting -= len(batch)
+                            self._cond.notify_all()  # admission space freed
+                            break
+                        if self._closed:
+                            return
+                        deadline = self._earliest_deadline_locked()
+                        timeout = (
+                            None if deadline is None
+                            else max(deadline - now, 1e-4)
+                        )
+                        self._cond.wait(timeout)
+                try:
+                    self._dispatch(batch)
+                except BaseException as exc:  # noqa: BLE001 — per-batch
+                    logger.exception("serving dispatch failed")
+                    metrics.counter_inc("serve/batch_errors")
+                    with self._stats_lock:
+                        self._stats["failed"] += len(batch)
+                    for request in batch:
+                        try:
+                            request.future.set_exception(exc)
+                        except InvalidStateError:  # pragma: no cover
+                            pass
+        except BaseException as exc:  # noqa: BLE001 — scheduler must not
+            # die silently: fail everything still queued and refuse new work.
+            logger.exception("serving scheduler crashed")
+            with self._cond:
+                self._closed = True
+                self._fail_pending_locked(exc)
+                self._cond.notify_all()
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        import jax
+
+        cfg = self.serve_config
+        bucket_len = batch[0].bucket_len
+        n = len(batch)
+        batch_size = next(b for b in cfg.batch_buckets if b >= n)
+        form_start = time.perf_counter()
+        for request in batch:
+            tracing.record_span(
+                "serve/queue_wait", request.submitted, form_start,
+                bucket=bucket_len,
+            )
+        with tracing.span("serve/batch_form", bucket=bucket_len,
+                          rows=n, batch=batch_size):
+            tokens = np.zeros((batch_size, bucket_len), np.int32)
+            lens = np.ones((batch_size,), np.int32)
+            for i, request in enumerate(batch):
+                tokens[i, :request.prompt_len] = request.prompt
+                lens[i] = request.prompt_len
+        cell = self._cell(bucket_len, batch_size)
+        self._rng, batch_rng = jax.random.split(self._rng)
+        with tracing.span("serve/prefill", bucket=bucket_len,
+                          batch=batch_size):
+            cache, logits0 = cell.prefill(self.params, tokens, lens)
+            jax.block_until_ready(logits0)
+        with tracing.span("serve/decode", bucket=bucket_len,
+                          batch=batch_size):
+            out = cell.decode(self.params, cache, logits0, lens, batch_rng)
+            out_tokens = np.asarray(out["tokens"])
+            out_nums = np.asarray(out["num_generated"])
+        done = time.perf_counter()
+
+        results = []
+        generated = 0
+        for i, request in enumerate(batch):
+            m = request.max_new_tokens
+            num = int(min(out_nums[i], m))
+            generated += num
+            result = ServeResult(
+                tokens=out_tokens[i, :m].copy(),
+                num_generated=num,
+                bucket_len=bucket_len,
+                batch_size=batch_size,
+                latency_seconds=done - request.submitted,
+            )
+            metrics.distribution_record(
+                "serve/latency_seconds", result.latency_seconds
+            )
+            results.append(result)
+
+        # Stats/metrics BEFORE the futures resolve: a caller waking from
+        # ``future.result()`` must see this batch already counted.
+        metrics.counter_inc("serve/batches")
+        metrics.counter_inc("serve/generated_tokens", generated)
+        metrics.gauge_set("serve/batch_occupancy", n / batch_size)
+        self._qps.add(done, n)
+        self._tokens_rate.add(done, generated)
+        with self._stats_lock:
+            self._stats["batches"] += 1
+            self._stats["slots"] += batch_size
+            self._stats["real_rows"] += n
+            self._stats["completed"] += n
+            self._stats["generated_tokens"] += generated
+        for request, result in zip(batch, results):
+            try:
+                request.future.set_result(result)
+            except InvalidStateError:  # pragma: no cover - cancelled
+                pass
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Counters snapshot + mean batch occupancy (real rows / dispatched
+        slots — the number the dynamic batcher is judged by)."""
+        with self._stats_lock:
+            snap = dict(self._stats)
+        snap["mean_batch_occupancy"] = (
+            snap["real_rows"] / snap["slots"] if snap["slots"] else 0.0
+        )
+        return snap

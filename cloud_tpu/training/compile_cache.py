@@ -19,7 +19,10 @@ engineered quantity instead of an accident, three ways:
   ``pipeline_io`` prefetch warms, and hands the trainer
   :class:`AotStep` wrappers that dispatch through the ready executable
   (falling back to the plain jitted function on any input mismatch —
-  compile-ahead can make a fit faster, never wrong).
+  compile-ahead can make a fit faster, never wrong).  The machinery is
+  not Trainer-specific: ``cloud_tpu.serving`` warms its whole
+  (bucket_len, batch_size) inference grid through the same registry +
+  worker at engine start (prefill/decode executables per grid cell).
 * **Safe persistent cache** — :func:`maybe_enable_persistent_cache`
   re-enables jax's on-disk compilation cache behind
   ``CLOUD_TPU_COMPILE_CACHE=<dir>``, gated on a one-time child-process

@@ -1,0 +1,153 @@
+"""End-to-end serving-engine check on CPU: parity, liveness, hygiene.
+
+Spins up a ``cloud_tpu.serving.ServingEngine`` in-process (TINY model,
+AOT-warmed two-bucket grid), fires N concurrent mixed-length requests
+from worker threads, and asserts the three contracts the engine makes:
+
+1. **Liveness** — every future resolves (no request stranded by the
+   batcher, the flush deadline, or shutdown).
+2. **Parity** — each request's tokens are identical (token-for-token,
+   greedy) to a direct unbatched ``generation.generate`` call for that
+   prompt alone: dynamic batching and bucket padding must be
+   observationally invisible.
+3. **Thread hygiene** — after ``close()``, no scheduler / compile-ahead
+   worker threads survive.
+
+Prints one JSON line per phase plus a final summary::
+
+    {"phase": "summary", "ok": true, "requests": ..., "batches": ...,
+     "mean_batch_occupancy": ..., ...}
+
+Wired as a ``slow``-marked test in tests/unit/test_serving.py (the same
+pattern as scripts/check_cold_start.py), so CI runs it every time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+# CPU by default: this is a correctness/hygiene harness, not a perf one.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_REQUESTS = 12
+MAX_NEW = 6
+
+#: Thread-name prefixes the engine may own while live; must all be gone
+#: after close().
+ENGINE_THREAD_PREFIXES = ("cloud-tpu-serve", "cloud-tpu-compile-ahead")
+
+
+def _engine_threads():
+    return [
+        t.name for t in threading.enumerate()
+        if t.name.startswith(ENGINE_THREAD_PREFIXES)
+    ]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--requests", type=int, default=N_REQUESTS)
+    parser.add_argument("--timeout", type=float, default=240.0,
+                        help="per-future resolve timeout (seconds)")
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cloud_tpu.models import generation, transformer
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+
+    config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+    params = transformer.init(jax.random.PRNGKey(0), config)
+    serve = ServeConfig(
+        max_new_tokens=MAX_NEW,
+        prompt_buckets=(8, 16),
+        batch_buckets=(1, 2, 4),
+        flush_deadline_s=0.02,
+        warmup=True,
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, 255, int(rng.integers(2, 17))).astype(np.int32)
+        for _ in range(args.requests)
+    ]
+
+    start = time.perf_counter()
+    futures = [None] * len(prompts)
+    engine = ServingEngine(params, config, serve, mesh=None)
+    try:
+        engine.wait_ready()
+        print(json.dumps({
+            "phase": "warmup", "ok": engine._warmup_plan.error is None,
+            "seconds": round(time.perf_counter() - start, 3),
+        }), flush=True)
+
+        # Concurrent submitters: requests arrive interleaved, from many
+        # threads, the way traffic would — not pre-sorted by bucket.
+        def submitter(i):
+            futures[i] = engine.submit(prompts[i])
+
+        workers = [
+            threading.Thread(target=submitter, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+
+        results = [f.result(timeout=args.timeout) for f in futures]
+        print(json.dumps({
+            "phase": "resolve", "ok": True, "requests": len(results),
+        }), flush=True)
+
+        mismatches = 0
+        for prompt, result in zip(prompts, results):
+            direct = generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([len(prompt)], np.int32), config,
+                max_new_tokens=MAX_NEW,
+                sample=generation.SampleConfig(temperature=0.0),
+            )
+            want = np.asarray(direct["tokens"])[0]
+            if not np.array_equal(result.tokens, want) or (
+                result.num_generated != int(direct["num_generated"][0])
+            ):
+                mismatches += 1
+        print(json.dumps({
+            "phase": "parity", "ok": mismatches == 0,
+            "mismatches": mismatches,
+        }), flush=True)
+        stats = engine.stats()
+    finally:
+        engine.close()
+
+    leaked = _engine_threads()
+    ok = (
+        mismatches == 0 and not leaked
+        and stats["completed"] == len(prompts)
+    )
+    print(json.dumps({
+        "phase": "summary",
+        "ok": ok,
+        "requests": stats["requests"],
+        "completed": stats["completed"],
+        "batches": stats["batches"],
+        "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 3),
+        "leaked_threads": leaked,
+        "wall_seconds": round(time.perf_counter() - start, 3),
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -230,6 +230,56 @@ class TestSampling:
                 sample=generation.SampleConfig(temperature=1.0),
             )
 
+    def test_composed_filters_with_top_k_one_reduce_to_penalized_greedy(self):
+        """top_k + top_p + repetition_penalty COMPOSED: with top_k=1 the
+        pipeline must collapse to the penalized argmax regardless of
+        temperature — penalty applies before the filters, top_k=1 leaves
+        one candidate, and top_p must keep (not filter out) that lone
+        survivor.  Catches ordering bugs between the three stages that
+        exercising each alone cannot."""
+        config, params, prompt, lens = self._setup()
+        composed = generation.generate(
+            params, prompt, lens, config, max_new_tokens=6,
+            sample=generation.SampleConfig(
+                temperature=1.7, top_k=1, top_p=0.9,
+                repetition_penalty=1e6,
+            ),
+            rng=jax.random.PRNGKey(2),
+        )["tokens"]
+        penalized_greedy = generation.generate(
+            params, prompt, lens, config, max_new_tokens=6,
+            sample=generation.SampleConfig(
+                temperature=0.0, repetition_penalty=1e6
+            ),
+        )["tokens"]
+        np.testing.assert_array_equal(
+            np.asarray(composed), np.asarray(penalized_greedy)
+        )
+
+    def test_composed_sampling_deterministic_and_well_formed(self):
+        """The full stack at once (temperature + top_k + top_p +
+        repetition_penalty + eos + min_new_tokens): reproducible under a
+        fixed key and structurally valid output."""
+        config, params, prompt, lens = self._setup()
+        sample = generation.SampleConfig(
+            temperature=0.8, top_k=50, top_p=0.9,
+            repetition_penalty=1.3, eos_id=3, pad_id=0, min_new_tokens=2,
+        )
+        out = [
+            generation.generate(
+                params, prompt, lens, config, max_new_tokens=6,
+                sample=sample, rng=jax.random.PRNGKey(9),
+            )
+            for _ in range(2)
+        ]
+        np.testing.assert_array_equal(
+            np.asarray(out[0]["tokens"]), np.asarray(out[1]["tokens"])
+        )
+        toks = np.asarray(out[0]["tokens"])[0]
+        num = int(out[0]["num_generated"][0])
+        assert num >= 2  # min_new_tokens honored
+        assert (toks[num:] == 0).all()  # pad after the generated span
+
 
 class TestBeamSearch:
     def _setup(self, seed=0):
@@ -380,6 +430,68 @@ class TestShardedGeneration:
                     jnp.full((2,), 4, jnp.int32), config,
                     max_new_tokens=2, rules=rules, mesh=mesh,
                 )
+
+
+class TestInferenceGuards:
+    """_check_inference_supported rejection paths: every inference entry
+    point (generate, beam_search, and the public alias the serving
+    engine validates through) must refuse the training-only pp and
+    zigzag_sp layouts up front — not fail obscurely inside the scan."""
+
+    def _pp_setup(self):
+        config = transformer.TINY
+        params = transformer.init(jax.random.PRNGKey(0), config)
+        mesh = parallel.MeshSpec({"pp": 2, "dp": 4}).build()
+        rules = parallel.DEFAULT_RULES.extended(layers="pp")
+        return config, params, mesh, rules
+
+    def _zigzag_setup(self):
+        config = transformer.TINY.scaled(zigzag_sp=True)
+        params = transformer.init(jax.random.PRNGKey(0), config)
+        mesh = parallel.MeshSpec({"sp": 4}).build(jax.devices()[:4])
+        return config, params, mesh
+
+    def test_beam_search_rejects_pp(self):
+        config, params, mesh, rules = self._pp_setup()
+        with parallel.use_mesh(mesh):
+            with pytest.raises(ValueError, match="pp"):
+                generation.beam_search(
+                    params, jnp.zeros((2, 4), jnp.int32),
+                    jnp.full((2,), 4, jnp.int32), config,
+                    num_beams=2, max_new_tokens=2, rules=rules, mesh=mesh,
+                )
+
+    def test_generate_rejects_zigzag(self):
+        config, params, mesh = self._zigzag_setup()
+        with parallel.use_mesh(mesh):
+            with pytest.raises(ValueError, match="zigzag"):
+                generation.generate(
+                    params, jnp.zeros((2, 8), jnp.int32),
+                    jnp.full((2,), 8, jnp.int32), config,
+                    max_new_tokens=2, mesh=mesh,
+                )
+
+    def test_beam_search_rejects_zigzag(self):
+        config, params, mesh = self._zigzag_setup()
+        with parallel.use_mesh(mesh):
+            with pytest.raises(ValueError, match="zigzag"):
+                generation.beam_search(
+                    params, jnp.zeros((2, 8), jnp.int32),
+                    jnp.full((2,), 8, jnp.int32), config,
+                    num_beams=2, max_new_tokens=2, mesh=mesh,
+                )
+
+    def test_public_alias_used_by_serving(self):
+        """check_inference_supported (the serving engine's startup
+        validation) raises the same errors, and passes a sane layout."""
+        config, params, mesh = self._zigzag_setup()
+        with pytest.raises(ValueError, match="zigzag"):
+            generation.check_inference_supported(
+                config, parallel.DEFAULT_RULES, mesh, "serving"
+            )
+        generation.check_inference_supported(
+            transformer.TINY, parallel.DEFAULT_RULES, None, "serving"
+        )
 
 
 class TestPromptLenValidation:

@@ -1,0 +1,399 @@
+"""Serving-engine tests: dynamic batching must be observationally invisible.
+
+The load-bearing contract (ISSUE 4 acceptance): for a mixed-length
+request set, engine outputs are token-for-token identical (greedy) to
+per-request ``generation.generate`` calls — bucket padding, batch
+padding rows, and co-batching with strangers must never leak into a
+request's tokens.  Around that: batch formation (full-batch and
+deadline-flush paths), admission control (block/reject + typed errors),
+graceful drain on shutdown, AOT warmup through the compile-cache
+registry, and the same thread-hygiene guarantee as
+test_pipeline_engine — a closed engine owns zero live threads.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloud_tpu.models import generation, transformer
+from cloud_tpu.serving import (
+    EngineClosedError,
+    QueueFullError,
+    ServeConfig,
+    ServingEngine,
+    SERVE_SCHEDULER_THREAD_NAME,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: Every thread the engine may own while live (scheduler + the
+#: compile-ahead warmup worker); the leak guard asserts none survive
+#: close() — same discipline as test_pipeline_engine's prefetch guard.
+ENGINE_THREAD_PREFIXES = ("cloud-tpu-serve", "cloud-tpu-compile-ahead")
+
+
+def _engine_threads():
+    return [
+        t for t in threading.enumerate()
+        if t.name.startswith(ENGINE_THREAD_PREFIXES)
+    ]
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+    params = transformer.init(jax.random.PRNGKey(0), config)
+    return config, params
+
+
+def _direct(params, config, prompt, max_new_tokens,
+            sample=generation.SampleConfig(temperature=0.0)):
+    return generation.generate(
+        params, jnp.asarray(prompt[None, :]),
+        jnp.asarray([len(prompt)], np.int32), config,
+        max_new_tokens=max_new_tokens, sample=sample,
+    )
+
+
+class TestParity:
+    def test_mixed_lengths_match_unbatched_generate(self, model):
+        """The acceptance criterion: 6 ragged prompts spanning two
+        buckets, batched by the engine, each identical to its own
+        unbatched greedy run."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=5, prompt_buckets=(8, 16),
+            batch_buckets=(1, 2, 4), flush_deadline_s=0.02,
+        )
+        rng = np.random.default_rng(0)
+        prompts = [
+            rng.integers(1, 255, n).astype(np.int32)
+            for n in (3, 8, 12, 5, 16, 2)
+        ]
+        engine = ServingEngine(params, config, serve, start=False)
+        futures = [engine.submit(p) for p in prompts]
+        engine.start()  # all queued up front: batches form deterministically
+        results = [f.result(timeout=120) for f in futures]
+        engine.close()
+
+        for prompt, result in zip(prompts, results):
+            want = _direct(params, config, prompt, 5)
+            np.testing.assert_array_equal(
+                result.tokens, np.asarray(want["tokens"])[0]
+            )
+            assert result.num_generated == int(want["num_generated"][0])
+        stats = engine.stats()
+        assert stats["completed"] == len(prompts)
+        # Batching actually happened (6 requests in < 6 dispatches).
+        assert stats["batches"] < len(prompts)
+        assert 0 < stats["mean_batch_occupancy"] <= 1.0
+
+    def test_per_request_max_new_tokens_trims(self, model):
+        """A request below the engine-wide decode length gets exactly a
+        shorter direct run's tokens (greedy is prefix-consistent)."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=6, prompt_buckets=(8,), batch_buckets=(1,),
+            flush_deadline_s=0.0,
+        )
+        prompt = np.asarray([5, 9, 17, 2], np.int32)
+        with ServingEngine(params, config, serve) as engine:
+            result = engine.submit(prompt, max_new_tokens=3).result(
+                timeout=120
+            )
+        want = _direct(params, config, prompt, 3)
+        assert result.tokens.shape == (3,)
+        np.testing.assert_array_equal(
+            result.tokens, np.asarray(want["tokens"])[0]
+        )
+        assert result.num_generated == int(want["num_generated"][0])
+
+    def test_eos_parity_through_engine(self, model):
+        """eos handling (emit, then pad) survives the batched path."""
+        config, params = model
+        prompt = np.asarray([7, 3, 11, 2], np.int32)
+        greedy = np.asarray(_direct(params, config, prompt, 6)["tokens"])[0]
+        eos = int(greedy[1])
+        sample = generation.SampleConfig(temperature=0.0, eos_id=eos,
+                                         pad_id=0)
+        serve = ServeConfig(
+            max_new_tokens=6, prompt_buckets=(8,), batch_buckets=(1, 2),
+            flush_deadline_s=0.0, sample=sample,
+        )
+        with ServingEngine(params, config, serve) as engine:
+            result = engine.submit(prompt).result(timeout=120)
+        want = _direct(params, config, prompt, 6, sample=sample)
+        np.testing.assert_array_equal(
+            result.tokens, np.asarray(want["tokens"])[0]
+        )
+        assert result.num_generated == int(want["num_generated"][0]) == 2
+
+    def test_sampled_decode_deterministic_per_seed(self, model):
+        """Non-greedy serving: the engine owns the rng chain, so the same
+        seed + the same deterministic batch formation reproduces."""
+        config, params = model
+        rng = np.random.default_rng(1)
+        prompts = [
+            rng.integers(1, 255, n).astype(np.int32) for n in (3, 5, 7, 4)
+        ]
+
+        def run():
+            serve = ServeConfig(
+                max_new_tokens=4, prompt_buckets=(8,), batch_buckets=(4,),
+                flush_deadline_s=5.0, seed=7,
+                sample=generation.SampleConfig(temperature=0.9, top_k=20),
+            )
+            engine = ServingEngine(params, config, serve, start=False)
+            futures = [engine.submit(p) for p in prompts]
+            engine.start()  # 4 queued = one full batch: one rng split
+            results = [f.result(timeout=120) for f in futures]
+            engine.close()
+            return results
+
+        first, second = run(), run()
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+class TestBatchFormation:
+    def test_lone_request_flushes_at_deadline(self, model):
+        """A single request must not wait for an unfillable batch: the
+        deadline flush dispatches it alone (occupancy 1/4)."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(4,),
+            flush_deadline_s=0.01,
+        )
+        with ServingEngine(params, config, serve) as engine:
+            result = engine.submit(
+                np.asarray([1, 2, 3], np.int32)
+            ).result(timeout=120)
+            assert result.batch_size == 4
+            assert engine.stats()["mean_batch_occupancy"] == 0.25
+
+    def test_expired_head_outranks_full_batch(self, model):
+        """flush_deadline_s is a real bound: an expired head in a
+        minority bucket is served BEFORE another bucket's full batch —
+        sustained traffic in one bucket must not starve the other
+        (deterministic check of the formation policy itself)."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8, 16), batch_buckets=(2,),
+            flush_deadline_s=0.0,
+        )
+        engine = ServingEngine(params, config, serve, start=False)
+        minority = engine.submit(np.asarray(range(1, 10), np.int32))  # len 9
+        for _ in range(2):  # a FULL majority-bucket batch, submitted later
+            engine.submit(np.asarray([1, 2, 3], np.int32))
+        batch = engine._pop_batch_locked(time.perf_counter())
+        # Everything is expired (deadline 0); the oldest head wins even
+        # though its bucket cannot fill, and the full bucket waits.
+        assert [r.future for r in batch] == [minority]
+        engine.close(drain=False)
+
+    def test_full_batch_dispatches_before_deadline(self, model):
+        """A full max-batch goes immediately — the (long) flush deadline
+        must not throttle saturated traffic."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(2,),
+            flush_deadline_s=30.0,
+        )
+        prompts = [np.asarray([1, 2], np.int32),
+                   np.asarray([3, 4, 5], np.int32)]
+        with ServingEngine(params, config, serve, start=False) as engine:
+            futures = [engine.submit(p) for p in prompts]
+            engine.start()
+            start = time.perf_counter()
+            for f in futures:
+                f.result(timeout=120)
+            assert time.perf_counter() - start < 30.0
+            assert engine.stats()["batches"] == 1
+
+
+class TestAdmission:
+    def test_reject_policy_raises_typed_error(self, model):
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(8,),
+            max_queue=2, admission="reject", flush_deadline_s=30.0,
+        )
+        engine = ServingEngine(params, config, serve, start=False)
+        prompt = np.asarray([1, 2], np.int32)
+        first, second = engine.submit(prompt), engine.submit(prompt)
+        with pytest.raises(QueueFullError):
+            engine.submit(prompt)
+        assert engine.stats()["rejected"] == 1
+        engine.close()  # never started: owed requests fail, typed
+        for f in (first, second):
+            with pytest.raises(EngineClosedError):
+                f.result(timeout=5)
+
+    def test_submit_validation(self, model):
+        config, params = model
+        serve = ServeConfig(max_new_tokens=2, prompt_buckets=(8,),
+                            batch_buckets=(1,))
+        engine = ServingEngine(params, config, serve, start=False)
+        with pytest.raises(ValueError, match="1-D"):
+            engine.submit(np.zeros((2, 2), np.int32))
+        with pytest.raises(ValueError, match="outside"):
+            engine.submit(np.zeros((9,), np.int32))  # > largest bucket
+        with pytest.raises(ValueError, match="outside"):
+            engine.submit(np.zeros((0,), np.int32))
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.submit(np.asarray([1], np.int32), max_new_tokens=3)
+        engine.close()
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="increasing"):
+            ServeConfig(prompt_buckets=(16, 8))
+        with pytest.raises(ValueError, match="admission"):
+            ServeConfig(admission="drop")
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            ServeConfig(max_new_tokens=0)
+
+    def test_submit_after_close_raises(self, model):
+        config, params = model
+        serve = ServeConfig(max_new_tokens=2, prompt_buckets=(8,),
+                            batch_buckets=(1,))
+        engine = ServingEngine(params, config, serve, start=False)
+        engine.close()
+        with pytest.raises(EngineClosedError):
+            engine.submit(np.asarray([1], np.int32))
+
+
+class TestShutdown:
+    def test_close_drains_admitted_requests(self, model):
+        """Admitted-but-unbatched requests (deadline far away, batch not
+        full) are served — not dropped — by a draining close."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(8,),
+            flush_deadline_s=30.0,
+        )
+        engine = ServingEngine(params, config, serve)
+        futures = [
+            engine.submit(np.asarray([1, 2, i], np.int32))
+            for i in range(1, 4)
+        ]
+        engine.close()  # drain=True default
+        for f in futures:
+            assert f.result(timeout=5) is not None
+        assert engine.stats()["completed"] == 3
+
+    def test_no_threads_leak_after_close(self, model):
+        """The acceptance criterion's hygiene half: scheduler + warmup
+        worker both joined by close()."""
+        config, params = model
+        assert not _engine_threads()
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(1,),
+            flush_deadline_s=0.0, warmup=True,
+        )
+        with ServingEngine(params, config, serve) as engine:
+            assert any(
+                t.name == SERVE_SCHEDULER_THREAD_NAME
+                for t in threading.enumerate()
+            )
+            engine.submit(np.asarray([4, 2], np.int32)).result(timeout=120)
+        assert not _engine_threads()
+
+    def test_close_is_idempotent(self, model):
+        config, params = model
+        serve = ServeConfig(max_new_tokens=2, prompt_buckets=(8,),
+                            batch_buckets=(1,))
+        engine = ServingEngine(params, config, serve)
+        engine.close()
+        engine.close()
+
+
+class TestWarmup:
+    def test_warmup_precompiles_the_grid(self, model):
+        """warmup=True lands every (bucket, batch) cell's prefill AND
+        decode executable in the AOT registry before any traffic; the
+        dispatch path then uses the compiled programs (AotStep attached),
+        and results still match the unbatched oracle."""
+        from cloud_tpu.training import compile_cache
+
+        config, params = model
+        before = compile_cache.registry_size()
+        serve = ServeConfig(
+            max_new_tokens=3, prompt_buckets=(8,), batch_buckets=(1, 2),
+            flush_deadline_s=0.0, warmup=True,
+        )
+        engine = ServingEngine(params, config, serve)
+        engine.wait_ready()
+        assert engine._warmup_plan.error is None
+        # 1 bucket x 2 batch sizes x {prefill, decode} = 4 new entries.
+        assert compile_cache.registry_size() >= before + 4
+        for key in ((8, 1), (8, 2)):
+            assert engine._cells[key].prefill.compiled is not None
+            assert engine._cells[key].decode.compiled is not None
+
+        prompt = np.asarray([9, 4, 1], np.int32)
+        result = engine.submit(prompt).result(timeout=120)
+        engine.close()
+        want = _direct(params, config, prompt, 3)
+        np.testing.assert_array_equal(
+            result.tokens, np.asarray(want["tokens"])[0]
+        )
+
+
+class TestObservability:
+    def test_serve_spans_and_metrics_recorded(self, model):
+        from cloud_tpu.monitoring import metrics, tracing
+
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(1, 2),
+            flush_deadline_s=0.0,
+        )
+        with tracing.collecting() as collector:
+            with ServingEngine(params, config, serve) as engine:
+                engine.submit(
+                    np.asarray([1, 2, 3], np.int32)
+                ).result(timeout=120)
+        agg = collector.aggregates()
+        for name in ("serve/queue_wait", "serve/batch_form",
+                     "serve/prefill", "serve/decode"):
+            assert agg.get(name, {}).get("count", 0) >= 1, name
+        snap = metrics.snapshot()
+        assert snap["counters"].get("serve/requests", 0) >= 1
+        assert snap["counters"].get("serve/batches", 0) >= 1
+        assert "serve/batch_occupancy" in snap["gauges"]
+        assert "serve/latency_seconds" in snap["distributions"]
+
+
+@pytest.mark.slow
+def test_check_serving_script():
+    """The CI serving harness end to end: N concurrent mixed-length
+    requests, parity vs unbatched generate, zero leaked threads."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "check_serving.py")],
+        capture_output=True, text=True, timeout=500,
+        cwd=REPO_ROOT, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, (proc.stdout or "") + (proc.stderr or "")
+    import json
+
+    summary = None
+    for line in proc.stdout.splitlines():
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue
+        if record.get("phase") == "summary":
+            summary = record
+    assert summary is not None, proc.stdout[-500:]
+    assert summary["ok"] is True
+    assert summary["completed"] == summary["requests"]
+    assert summary["leaked_threads"] == []

@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 
 from cloud_tpu import monitoring
+from cloud_tpu.monitoring import metrics
 from cloud_tpu.monitoring import report as report_lib
 from cloud_tpu.monitoring import tracing
 
@@ -269,6 +270,83 @@ class TestReport:
 
     def test_cli_handles_missing_file(self):
         assert report_lib.main(["/nope/missing.json"]) == 2
+
+    def _dump_with_serving(self, tmp_path):
+        with tracing.collecting():
+            with tracing.span("step/compute"):
+                time.sleep(0.002)
+            now = time.perf_counter()
+            # Cross-thread queue waits land via record_span; the compute
+            # phases are ordinary context-manager spans.
+            tracing.record_span("serve/queue_wait", now - 0.05, now)
+            tracing.record_span("serve/queue_wait", now - 0.01, now)
+            with tracing.span("serve/batch_form"):
+                pass
+            with tracing.span("serve/prefill"):
+                time.sleep(0.004)
+            with tracing.span("serve/decode"):
+                time.sleep(0.008)
+            return tracing.dump_timeline(str(tmp_path / "serve.json"))
+
+    def test_serving_breakdown_rows(self, tmp_path):
+        path = self._dump_with_serving(tmp_path)
+        report = report_lib.TraceReport.from_file(path)
+        rows = report.serving_rows()
+        # Request order, not cost order; the training span is excluded.
+        assert [r["name"] for r in rows] == [
+            "serve/queue_wait", "serve/batch_form", "serve/prefill",
+            "serve/decode",
+        ]
+        assert rows[0]["count"] == 2  # both queue waits aggregated
+        assert abs(sum(r["pct_serve"] for r in rows) - 100.0) < 1e-6
+        # Queue wait (60ms recorded) dominates prefill+decode (~12ms).
+        assert rows[0]["pct_serve"] > 50.0
+
+    def test_serving_breakdown_rendered(self, tmp_path):
+        path = self._dump_with_serving(tmp_path)
+        rendered = report_lib.TraceReport.from_file(path).render()
+        assert "serving breakdown" in rendered
+        assert "% serve" in rendered
+        assert "serve/queue_wait" in rendered
+
+    def test_no_serving_section_without_serve_spans(self, tmp_path):
+        rendered = report_lib.TraceReport.from_file(
+            self._dump(tmp_path)
+        ).render()
+        assert "serving breakdown" not in rendered
+
+
+class TestRecordSpan:
+    def test_lands_in_timeline_aggregates_and_metrics(self):
+        metrics.reset()
+        with tracing.collecting() as collector:
+            start = time.perf_counter()
+            tracing.record_span("serve/queue_wait", start, start + 0.25,
+                                bucket=32)
+        agg = collector.aggregates()["serve/queue_wait"]
+        assert agg["count"] == 1
+        assert abs(agg["total_seconds"] - 0.25) < 1e-6
+        event = collector.events()[-1]
+        assert event["name"] == "serve/queue_wait"
+        assert event["ph"] == "X"
+        assert event["args"]["bucket"] == 32
+        assert "span/serve/queue_wait" in metrics.snapshot()["distributions"]
+
+    def test_noop_when_disabled(self):
+        tracing.disable()
+        metrics.reset()
+        now = time.perf_counter()
+        tracing.record_span("serve/queue_wait", now - 1.0, now)
+        assert "span/serve/queue_wait" not in metrics.snapshot()[
+            "distributions"
+        ]
+
+    def test_negative_interval_clamps_to_zero(self):
+        with tracing.collecting() as collector:
+            now = time.perf_counter()
+            tracing.record_span("serve/queue_wait", now, now - 5.0)
+        agg = collector.aggregates()["serve/queue_wait"]
+        assert agg["total_seconds"] == 0.0
 
 
 class TestXprofMirroring:
