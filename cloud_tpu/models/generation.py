@@ -243,14 +243,15 @@ def _final_logits(params, x, config):
     return transformer.lm_logits(params, x, config)
 
 
-def _prefill(params, prompt_tokens, prompt_lens, config, s, rules, mesh,
-             kv_quant: bool = False):
-    """One full forward over the prompt buffer: returns the KV cache
-    (size ``s``, positions [0, prompt_len) filled) and the next-token
-    logits [B, V] at each row's last real prompt position — shared by
-    sampling and beam decoding."""
+def _prefill_forward(params, prompt_tokens, prompt_lens, config, rules,
+                     mesh):
+    """The prompt forward pass alone: per-layer k/v stacks
+    [L, B, T_prompt, H, hd] (raw, pre-cast) plus the next-token logits
+    [B, V] at each row's last real prompt position.  Where those k/v
+    land is the caller's business: :func:`_prefill` writes them at the
+    origin of a fresh batch cache, :func:`insert_slot_program` into one
+    row of a persistent slot grid."""
     b, t_prompt = prompt_tokens.shape
-    cache = _init_cache(config, b, s, rules, mesh, kv_quant=kv_quant)
     positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
     prompt_mask = (positions < prompt_lens[:, None]).astype(jnp.int32)
     x = layers.embedding_apply(params["embed"], prompt_tokens,
@@ -268,29 +269,76 @@ def _prefill(params, prompt_tokens, prompt_lens, config, s, rules, mesh,
     x, (k_pref, v_pref) = jax.lax.scan(
         prefill_body, x, (params["layers"],)
     )
-    zeros5 = (0, 0, 0, 0, 0)
-    if kv_quant:
-        for name, pref in (("k", k_pref), ("v", v_pref)):
-            q, sc = _quantize_kv(pref)
-            cache[name] = jax.lax.dynamic_update_slice(
-                cache[name], q, zeros5
-            )
-            cache[f"{name}_scale"] = jax.lax.dynamic_update_slice(
-                cache[f"{name}_scale"], sc, zeros5
-            )
-    else:
-        cache["k"] = jax.lax.dynamic_update_slice(
-            cache["k"], k_pref.astype(config.dtype), zeros5
-        )
-        cache["v"] = jax.lax.dynamic_update_slice(
-            cache["v"], v_pref.astype(config.dtype), zeros5
-        )
     last_idx = (prompt_lens - 1)[:, None, None]
     last_x = jnp.take_along_axis(
         x, jnp.broadcast_to(last_idx, (b, 1, x.shape[-1])), axis=1
     )
     logits0 = _final_logits(params, last_x, config)[:, 0]
+    return k_pref, v_pref, logits0
+
+
+def _write_prefill(cache, k_pref, v_pref, start, config):
+    """Write a prefill's k/v stacks into ``cache`` at the 5-D ``start``
+    index (quantizing first when the cache is int8)."""
+    if "k_scale" in cache:
+        for name, pref in (("k", k_pref), ("v", v_pref)):
+            q, sc = _quantize_kv(pref)
+            cache[name] = jax.lax.dynamic_update_slice(
+                cache[name], q, start
+            )
+            cache[f"{name}_scale"] = jax.lax.dynamic_update_slice(
+                cache[f"{name}_scale"], sc, start
+            )
+    else:
+        cache["k"] = jax.lax.dynamic_update_slice(
+            cache["k"], k_pref.astype(config.dtype), start
+        )
+        cache["v"] = jax.lax.dynamic_update_slice(
+            cache["v"], v_pref.astype(config.dtype), start
+        )
+    return cache
+
+
+def _prefill(params, prompt_tokens, prompt_lens, config, s, rules, mesh,
+             kv_quant: bool = False):
+    """One full forward over the prompt buffer: returns the KV cache
+    (size ``s``, positions [0, prompt_len) filled) and the next-token
+    logits [B, V] at each row's last real prompt position — shared by
+    sampling and beam decoding."""
+    b, _ = prompt_tokens.shape
+    cache = _init_cache(config, b, s, rules, mesh, kv_quant=kv_quant)
+    k_pref, v_pref, logits0 = _prefill_forward(
+        params, prompt_tokens, prompt_lens, config, rules, mesh
+    )
+    cache = _write_prefill(cache, k_pref, v_pref, (0, 0, 0, 0, 0), config)
     return cache, logits0
+
+
+def _decode_step(params, cache, token, cur_len, config, rules, mesh):
+    """One single-token decode step for every row at once: embed
+    ``token`` [B], run the scanned layer stack against the cache (each
+    row's k/v written at its ``cur_len``), return the updated cache and
+    the next-token logits [B, V].  The shared inner loop of
+    :func:`_decode_tokens`, :func:`beam_search`, and
+    :func:`decode_chunk_program`."""
+    x = layers.embedding_apply(
+        params["embed"], token[:, None], dtype=config.dtype,
+        rules=rules, mesh=mesh,
+    )
+    x = x * math.sqrt(config.dim)
+
+    def layer_body(x, layer_slice):
+        layer_params, cache_l = layer_slice
+        x, cache_l = _decode_layer(
+            layer_params, x, cache_l, cur_len, config, rules
+        )
+        return x, cache_l
+
+    x, cache = jax.lax.scan(
+        layer_body, x, (params["layers"], cache)
+    )
+    logits = _final_logits(params, x, config)[:, 0]
+    return cache, logits
 
 
 def _decode_tokens(params, cache, logits0, prompt_lens, config, *,
@@ -326,23 +374,9 @@ def _decode_tokens(params, cache, logits0, prompt_lens, config, *,
     # real emitted token; later slots are pads whose compute is discarded.
     def step(carry, i):
         cache, cur_len, token, post_eos, seen, rng = carry
-        x = layers.embedding_apply(
-            params["embed"], token[:, None], dtype=config.dtype,
-            rules=rules, mesh=mesh,
+        cache, logits = _decode_step(
+            params, cache, token, cur_len, config, rules, mesh
         )
-        x = x * math.sqrt(config.dim)
-
-        def layer_body(x, layer_slice):
-            layer_params, cache_l = layer_slice
-            x, cache_l = _decode_layer(
-                layer_params, x, cache_l, cur_len, config, rules
-            )
-            return x, cache_l
-
-        x, cache = jax.lax.scan(
-            layer_body, x, (params["layers"], cache)
-        )
-        logits = _final_logits(params, x, config)[:, 0]
         rng, step_rng = jax.random.split(rng)
         # This step samples generated-token index i+1.
         allow = (
@@ -537,6 +571,199 @@ def decode_program(
     return {"tokens": tokens, "num_generated": num_generated}
 
 
+# --------------------------------------------------------------------------
+# Continuous batching: slot-grid programs (the ``cloud_tpu.serving``
+# iteration-level scheduler).  The unit of work is no longer a batch of
+# requests but a persistent grid of ``num_slots`` decode slots over a
+# static ``max_len`` KV cache: requests are prefilled INTO a free slot at
+# their own bucket length (:func:`insert_slot_program`), decode advances
+# every active slot by ``chunk_size`` tokens per dispatch
+# (:func:`decode_chunk_program`), and a slot that finishes — per-slot
+# ``max_new_tokens`` exhausted, or eos sampled — simply goes inactive
+# mid-chunk and is refilled by the host between chunks.  Greedy outputs
+# are token-for-token identical to :func:`generate` (same
+# :func:`_decode_step`, same sampling order; the only dropped work is
+# the forward pass generate() runs on post-finish pad tokens, which
+# never influences emitted tokens).
+
+
+def init_slot_cache(config, num_slots: int, max_len: int, *,
+                    rules: ShardingRules = DEFAULT_RULES, mesh=None,
+                    kv_quant: bool = False):
+    """The persistent decode grid: a zeroed KV cache with ``num_slots``
+    batch rows of ``max_len`` positions (``max_len`` must cover the
+    largest prompt bucket plus the engine-wide ``max_new_tokens``).
+    Allocated once per engine and carried through every insert/chunk
+    program — slot reuse overwrites in place, never reallocates."""
+    return _init_cache(config, num_slots, max_len, rules, mesh,
+                       kv_quant=kv_quant)
+
+
+def init_slot_state(config, num_slots: int, *,
+                    sample: SampleConfig = SampleConfig(temperature=0.0)):
+    """Per-slot scheduler state carried alongside the slot cache.
+
+    ``pos`` — filled KV length (the next write index); ``tok`` — the
+    last sampled, not-yet-consumed token; ``remaining`` — emissions this
+    slot still owes; ``emitted`` — emissions so far (the
+    ``min_new_tokens`` gate); ``active`` — whether the slot decodes.
+    ``seen`` ([num_slots, vocab] bool) rides along only when the sample
+    config applies a repetition penalty — the state pytree's structure
+    is static per engine, so one chunk program serves the whole run.
+    """
+    state = {
+        "pos": jnp.zeros((num_slots,), jnp.int32),
+        "tok": jnp.full((num_slots,), sample.pad_id, jnp.int32),
+        "remaining": jnp.zeros((num_slots,), jnp.int32),
+        "emitted": jnp.zeros((num_slots,), jnp.int32),
+        "active": jnp.zeros((num_slots,), bool),
+    }
+    if sample.repetition_penalty != 1.0:
+        state["seen"] = jnp.zeros((num_slots, config.vocab_size), bool)
+    return state
+
+
+def insert_slot_program(
+    params,
+    cache,
+    state,
+    prompt_tokens: jnp.ndarray,
+    prompt_len,
+    slot,
+    max_new_tokens,
+    config: transformer.TransformerConfig,
+    *,
+    sample: SampleConfig = SampleConfig(temperature=0.0),
+    rng: Optional[jax.Array] = None,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+):
+    """Prefill one request into one slot of a live grid.
+
+    ``prompt_tokens`` is a [1, bucket_len] padded prompt (the program
+    specializes per bucket length — the compile grid is one insert
+    program per prompt bucket, not per batch size); ``prompt_len`` /
+    ``slot`` / ``max_new_tokens`` are traced int32 scalars, so one
+    executable serves every slot and every per-request decode budget.
+    Writes the prompt's k/v into the slot's cache row, samples the first
+    token from the prefill logits (exactly :func:`generate`'s ``tok0``),
+    and arms the slot state: ``remaining = max_new_tokens - 1``, active
+    unless the request is already finished (``max_new_tokens == 1`` or
+    the first token sampled eos).  Stale cache beyond the new prompt is
+    harmless — attention masks positions ``>= pos`` and decode
+    overwrites each position before it can become valid.  Returns
+    ``(cache, state, first_token)``.
+    """
+    t_prompt = prompt_tokens.shape[1]
+    prompt_len = jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1, t_prompt)
+    lens = jnp.reshape(prompt_len, (1,))
+    k_pref, v_pref, logits0 = _prefill_forward(
+        params, prompt_tokens, lens, config, rules, mesh
+    )
+    slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.int32(0)
+    cache = _write_prefill(
+        cache, k_pref, v_pref, (zero, slot, zero, zero, zero), config
+    )
+
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    need_min = sample.eos_id is not None and sample.min_new_tokens > 0
+    allow0 = jnp.full((1,), False) if need_min else None
+    tok0 = sample_logits(
+        rng, logits0, sample, allow_eos=allow0
+    ).astype(jnp.int32)[0]
+
+    max_new_tokens = jnp.asarray(max_new_tokens, jnp.int32)
+    active0 = max_new_tokens > 1
+    if sample.eos_id is not None:
+        active0 = active0 & (tok0 != sample.eos_id)
+    state = dict(state)
+    state["pos"] = state["pos"].at[slot].set(prompt_len)
+    state["tok"] = state["tok"].at[slot].set(tok0)
+    state["remaining"] = state["remaining"].at[slot].set(max_new_tokens - 1)
+    state["emitted"] = state["emitted"].at[slot].set(1)
+    state["active"] = state["active"].at[slot].set(active0)
+    if "seen" in state:
+        row = jnp.zeros((config.vocab_size,), bool).at[tok0].set(True)
+        state["seen"] = state["seen"].at[slot].set(row)
+    return cache, state, tok0
+
+
+def decode_chunk_program(
+    params,
+    cache,
+    state,
+    config: transformer.TransformerConfig,
+    *,
+    chunk_size: int,
+    sample: SampleConfig = SampleConfig(temperature=0.0),
+    rng: Optional[jax.Array] = None,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+):
+    """Advance every active slot by up to ``chunk_size`` tokens.
+
+    One ``lax.scan`` of ``chunk_size`` single-token steps over the whole
+    grid (static shapes — ONE compile serves the entire serving run).
+    Each step consumes every slot's carried token at its own ``pos``,
+    samples the next, and emits it where the slot was active; a slot
+    whose ``remaining`` hits zero or that samples eos deactivates
+    *mid-chunk* and stops advancing (its residual lanes still flow
+    through the compute — that is the static-shape price — but its
+    ``pos`` freezes and its emissions are masked out).  Inactive slots
+    contribute masked lanes only; their frozen-position cache writes are
+    overwritten by the next insert before they can ever be attended.
+
+    Returns ``(cache, state, tokens, valid)`` with ``tokens``/``valid``
+    shaped [num_slots, chunk_size]: ``valid[s, i]`` marks a real
+    emission (a prefix per row — slots only ever deactivate mid-chunk,
+    reactivation happens between chunks via
+    :func:`insert_slot_program`).
+    """
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    num_slots = state["tok"].shape[0]
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    track_seen = sample.repetition_penalty != 1.0
+    need_min = sample.eos_id is not None and sample.min_new_tokens > 0
+    rows = jnp.arange(num_slots)
+
+    def step(carry, step_rng):
+        cache, state = carry
+        active = state["active"]
+        cache, logits = _decode_step(
+            params, cache, state["tok"], state["pos"], config, rules, mesh
+        )
+        allow = (
+            state["emitted"] >= sample.min_new_tokens if need_min else None
+        )
+        tok = sample_logits(
+            step_rng, logits, sample,
+            seen=state["seen"] if track_seen else None, allow_eos=allow,
+        ).astype(jnp.int32)
+        tok = jnp.where(active, tok, jnp.int32(sample.pad_id))
+        stride = active.astype(jnp.int32)
+        new_state = dict(state)
+        new_state["pos"] = state["pos"] + stride
+        new_state["remaining"] = state["remaining"] - stride
+        new_state["emitted"] = state["emitted"] + stride
+        finished = new_state["remaining"] <= 0
+        if sample.eos_id is not None:
+            finished = finished | (tok == sample.eos_id)
+        new_state["active"] = active & ~finished
+        new_state["tok"] = jnp.where(active, tok, state["tok"])
+        if track_seen:
+            # Unconditional like _decode_tokens: inactive rows set the
+            # pad bit in a row the next insert resets anyway.
+            new_state["seen"] = state["seen"].at[rows, tok].set(True)
+        return (cache, new_state), (tok, active)
+
+    (cache, state), (toks, valid) = jax.lax.scan(
+        step, (cache, state), jax.random.split(rng, chunk_size)
+    )
+    return cache, state, toks.T, valid.T
+
+
 def check_inference_supported(config, rules, mesh, what: str = "inference"):
     """Public guard for callers that bypass :func:`generate`'s own checks
     (the serving engine validates once at startup, then dispatches the
@@ -644,24 +871,12 @@ def beam_search(
     def step(carry, i):
         (cache, cur_len, token, scores_l, hist_l, n_l,
          scores_f, hist_f, n_f) = carry
-        x = layers.embedding_apply(
-            params["embed"], token.reshape(b * k)[:, None],
-            dtype=config.dtype, rules=rules, mesh=mesh,
-        )
-        x = x * math.sqrt(config.dim)
-
-        def layer_body(x, layer_slice):
-            layer_params, cache_l = layer_slice
-            x, cache_l = _decode_layer(
-                layer_params, x, cache_l, cur_len, config, rules
-            )
-            return x, cache_l
-
-        x, cache = jax.lax.scan(
-            layer_body, x, (params["layers"], cache)
+        cache, step_logits = _decode_step(
+            params, cache, token.reshape(b * k), cur_len, config, rules,
+            mesh,
         )
         logprobs = jax.nn.log_softmax(
-            _final_logits(params, x, config)[:, 0], axis=-1
+            step_logits, axis=-1
         ).reshape(b, k, vocab)
         total = scores_l[:, :, None] + logprobs  # [B, K, V]
 

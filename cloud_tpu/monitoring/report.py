@@ -8,11 +8,14 @@ summarization is importable as :class:`TraceReport` for programmatic use
 
 When the timeline contains serving spans (``serve/*`` — the
 ``cloud_tpu.serving`` engine), a dedicated breakdown follows the main
-table: queue wait vs batch formation vs prefill vs decode, each as a
-percentage of total serve-span time, so "requests are slow" resolves
-one level deeper — waiting for a batch slot (raise ``max_queue`` /
-shrink ``flush_deadline_s``) vs paying compute (shrink buckets, raise
-occupancy) — without leaving the CLI.
+table: queue wait vs prefill vs decode/chunk, each as a percentage of
+total serve-span time, so "requests are slow" resolves one level deeper
+— waiting for a slot (raise ``max_queue``, add capacity) vs paying
+compute (shrink buckets, raise occupancy) — without leaving the CLI.
+Continuous-batching timelines (``serve/chunk`` spans) additionally get
+a grid-health line: chunk count, mean slot occupancy, mean active
+slots, and total emitted tokens, aggregated from the per-dispatch span
+attributes the scheduler stamps on every chunk.
 """
 
 from __future__ import annotations
@@ -77,11 +80,45 @@ class TraceReport:
         return rows
 
     #: The serving phases, in request order (the ``cloud_tpu.serving``
-    #: engine's span names); anything else under ``serve/`` rides along.
+    #: engine's span names — batch-mode batch_form/decode, continuous-
+    #: mode chunk); anything else under ``serve/`` rides along.
     _SERVE_ORDER = (
         "serve/queue_wait", "serve/batch_form", "serve/prefill",
-        "serve/decode",
+        "serve/decode", "serve/chunk",
     )
+
+    def continuous_summary(self) -> Optional[Dict[str, float]]:
+        """Aggregate the ``serve/chunk`` spans' per-dispatch attributes
+        (the continuous-batching scheduler stamps ``active``, ``slots``,
+        ``tokens`` and ``occupancy`` on every chunk) into one line of
+        grid health: how full the decode grid ran.  None when the
+        timeline has no chunk spans (batch-mode or non-serving trace).
+        """
+        chunks = [
+            e.get("args") or {} for e in self.events
+            if e.get("name") == "serve/chunk"
+        ]
+        if not chunks:
+            return None
+
+        def mean_of(key):
+            values = [
+                a[key] for a in chunks
+                if isinstance(a.get(key), (int, float))
+            ]
+            return sum(values) / len(values) if values else None
+
+        tokens = [
+            a["tokens"] for a in chunks
+            if isinstance(a.get("tokens"), (int, float))
+        ]
+        return {
+            "chunks": len(chunks),
+            "mean_occupancy": mean_of("occupancy"),
+            "mean_active": mean_of("active"),
+            "slots": mean_of("slots"),
+            "tokens": sum(tokens) if tokens else None,
+        }
 
     def serving_rows(self, rows: Optional[List[Dict[str, float]]] = None
                      ) -> List[Dict[str, float]]:
@@ -152,6 +189,22 @@ class TraceReport:
                 for r in serve_rows
             ], ("phase", "count", "total", "mean", "p50", "max",
                 "% serve")))
+        continuous = self.continuous_summary()
+        if continuous:
+            parts = [f"{continuous['chunks']} chunks"]
+            if continuous["mean_occupancy"] is not None:
+                parts.append(
+                    f"mean occupancy {continuous['mean_occupancy']:.1%}"
+                )
+            if continuous["mean_active"] is not None:
+                active = f"mean active {continuous['mean_active']:.1f}"
+                if continuous["slots"]:
+                    active += f"/{continuous['slots']:.0f} slots"
+                parts.append(active)
+            if continuous["tokens"] is not None:
+                parts.append(f"{continuous['tokens']:.0f} tokens")
+            lines.append("")
+            lines.append("continuous batching: " + " · ".join(parts))
         lines.append("")
         lines.append(
             f"{len(self.events)} spans over {_fmt_s(self.wall_seconds())} "

@@ -1,11 +1,16 @@
-"""In-process TPU serving: dynamic-batched inference on the generation path.
+"""In-process TPU serving: continuous-batched inference on the
+generation path.
 
 The training side got its occupancy engineering in PRs 2-3 (prefetch,
 fused dispatch, compile-ahead); this package is the inference
 counterpart — a request queue + scheduler that drives
-``models.generation``'s prefill/decode programs at high batch occupancy
-while individual callers see a simple future-per-request API.  See
-``docs/serving.md`` and :mod:`cloud_tpu.serving.engine`.
+``models.generation``'s slot-grid programs (insert + chunk decode) at
+steady-state occupancy, retiring and refilling decode slots between
+chunks, while individual callers see a simple future-per-request API.
+The PR 4 batch-synchronous scheduler survives as
+``ServeConfig(scheduler="batch")``, the baseline the continuous path is
+measured against.  See ``docs/serving.md`` and
+:mod:`cloud_tpu.serving.engine`.
 """
 
 from cloud_tpu.serving.engine import (

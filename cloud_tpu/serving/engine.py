@@ -1,48 +1,70 @@
-"""Dynamic-batched serving engine over the generation path.
+"""Continuous-batching serving engine over the generation path.
 
 ``models.generation`` can decode a *batch* of prompts as one compiled
 program, but traffic arrives one request at a time; serving economics on
 TPU hinge on the gap between those two facts (batched decode occupancy
 amortizes the weight reads every decode step re-pays — arxiv 2605.25645,
-arxiv 2309.08918).  :class:`ServingEngine` closes the gap in-process:
+arxiv 2309.08918).  :class:`ServingEngine` closes the gap in-process,
+with two schedulers sharing one submit/future/admission surface:
 
-* **Dynamic batching** — ``submit()`` enqueues a request and returns a
-  ``concurrent.futures.Future``; a scheduler thread groups waiting
-  requests by *prompt-length bucket*, pads each group to its bucket
-  shape, and dispatches prefill + scan-decode as two compiled programs
-  (``generation.prefill_program`` / ``generation.decode_program``),
-  demultiplexing per-row results back onto the futures.  A batch forms
-  when a bucket fills to the largest batch bucket or when its oldest
-  request has waited ``flush_deadline_s`` — a lone request is never
-  stranded behind an unfillable batch.
-* **Bucketed AOT warmup** — shapes are quantized to a static
-  ``(bucket_len, batch_size)`` grid, so the full set of executables the
-  engine can ever dispatch is enumerable; ``warmup=True`` pre-compiles
-  the grid through ``training.compile_cache`` (the same AOT registry +
-  background worker the trainer's compile-ahead uses) at engine start,
-  making first-request latency an engineered quantity like PR 3 did for
-  first-step latency.
+* **Continuous batching** (``scheduler="continuous"``, the default) —
+  iteration-level scheduling over a persistent decode grid: a static
+  ``(num_slots, max_len)`` KV cache plus per-slot ``{position,
+  remaining, active}`` state lives on the device for the engine's whole
+  life.  Decode runs in fixed-size token chunks (ONE compiled
+  ``generation.decode_chunk_program`` scanning ``chunk_tokens`` steps
+  over every slot); between chunks the scheduler retires finished slots
+  — per-request ``max_new_tokens`` exhausted or eos sampled, the slot
+  deactivates *mid-chunk* via the active mask — completes their futures
+  immediately, and prefills queued requests into the freed slots
+  (``generation.insert_slot_program``, one program per prompt bucket,
+  at the request's own bucket length).  A short request never rides out
+  a long neighbor's decode: occupancy is a steady-state quantity
+  instead of the batch-synchronous sawtooth (Orca-style iteration
+  scheduling — arxiv 2605.25645).
+* **Dynamic batching** (``scheduler="batch"``, the PR 4 path) — the
+  scheduler groups waiting requests by prompt-length bucket, pads each
+  group to a static ``(bucket_len, batch_size)`` grid point, and
+  dispatches prefill + scan-decode as two compiled programs
+  (``generation.prefill_program`` / ``generation.decode_program``).  A
+  batch forms on a full max-batch or a ``flush_deadline_s`` timeout.
+  Kept as the baseline the continuous scheduler is measured against
+  (tests assert continuous slot occupancy beats it on churn workloads).
+* **AOT warmup** — either grid is enumerable, so ``warmup=True``
+  pre-compiles it through ``training.compile_cache`` (the trainer's AOT
+  registry + background worker) at engine start: continuous warms one
+  insert program per prompt bucket plus the single chunk program;
+  batch warms prefill/decode per ``(bucket_len, batch_size)`` cell.
 * **Admission control** — the waiting set is bounded by ``max_queue``;
   ``admission="block"`` makes ``submit`` wait for space,
   ``admission="reject"`` raises :class:`QueueFullError` (typed, so a
   caller can shed load).  ``close()`` drains gracefully: admitted
-  requests complete, later submits raise :class:`EngineClosedError`, and
-  no scheduler/warmup thread survives (same thread-hygiene contract as
+  requests complete (a partially full grid decodes to the last slot),
+  later submits raise :class:`EngineClosedError`, and no
+  scheduler/warmup thread survives (same thread-hygiene contract as
   ``training.pipeline_io``).
 * **Observability** — ``serve/queue_wait`` (recorded cross-thread via
-  ``tracing.record_span``), ``serve/batch_form``, ``serve/prefill`` and
-  ``serve/decode`` spans; ``serve/qps`` and ``serve/tokens_per_sec``
-  windowed-rate gauges, a ``serve/batch_occupancy`` gauge and a
+  ``tracing.record_span``), ``serve/prefill`` spans in both modes;
+  ``serve/chunk`` spans (with per-dispatch ``active``/``occupancy``
+  attributes) in continuous mode, ``serve/batch_form``/``serve/decode``
+  in batch mode.  ``serve/qps`` and ``serve/tokens_per_sec``
+  windowed-rate gauges, ``serve/slot_occupancy`` /
+  ``serve/batch_occupancy`` gauges, slot-churn counters
+  (``serve/slot_inserts``, ``serve/slot_retires``,
+  ``serve/slot_expired``, ``serve/chunks``) and a
   ``serve/latency_seconds`` distribution.  ``python -m
   cloud_tpu.monitoring.report`` renders the serve spans as a dedicated
-  queue-wait vs prefill vs decode breakdown.
+  breakdown, with a continuous-batching section when chunk spans are
+  present.
 
-Greedy parity is the correctness contract: for any mix of prompt
-lengths, a request's tokens are identical to a direct per-request
-``generation.generate`` call (padding rows and bucket tails are masked
-out of attention, and greedy decode is prefix-consistent, so per-request
-``max_new_tokens`` is served by trimming the engine-wide decode length).
-Proven in tests/unit/test_serving.py and scripts/check_serving.py.
+Greedy parity is the correctness contract in both modes: for any mix of
+prompt lengths, arrival times, and per-request decode budgets, a
+request's tokens are identical to a direct per-request
+``generation.generate`` call (slot/bucket padding is masked out of
+attention, greedy decode is prefix-consistent, and the chunk program
+replays generate()'s exact sampling order).  Proven in
+tests/unit/test_serving.py and scripts/check_serving.py under slot
+churn.
 """
 
 from __future__ import annotations
@@ -79,14 +101,19 @@ class ServeConfig:
     """Engine knobs (all static — they define the compiled-program grid).
 
     ``prompt_buckets`` are the padded prompt lengths the engine compiles
-    for (a request lands in the smallest bucket that fits it);
-    ``batch_buckets`` are the batch sizes (a formed group pads up to the
-    smallest batch bucket that fits, so occupancy is explicit: 3 requests
-    in a bucket-4 dispatch is 75%).  The compiled grid is their cross
-    product x {prefill, decode}.  ``flush_deadline_s`` bounds how long a
-    request may wait for co-batching once it is first in line;
-    ``max_queue``/``admission`` are the backpressure contract
-    (module docstring).
+    for (a request lands in the smallest bucket that fits it).  Under
+    the default continuous scheduler the compiled grid is one insert
+    program per prompt bucket plus ONE chunk program over the
+    ``(num_slots, prompt_buckets[-1] + max_new_tokens)`` slot cache;
+    ``chunk_tokens`` is the scheduling quantum (admission/retirement
+    granularity vs dispatch overhead — docs/serving.md).  Under
+    ``scheduler="batch"``, ``batch_buckets`` are the batch sizes (a
+    formed group pads up to the smallest batch bucket that fits, so
+    occupancy is explicit: 3 requests in a bucket-4 dispatch is 75%),
+    the grid is the cross product x {prefill, decode}, and
+    ``flush_deadline_s`` bounds how long a request may wait for
+    co-batching once it is first in line.  ``max_queue``/``admission``
+    are the backpressure contract in both modes (module docstring).
     """
 
     max_new_tokens: int = 32
@@ -95,6 +122,17 @@ class ServeConfig:
     flush_deadline_s: float = 0.01
     max_queue: int = 256
     admission: str = "block"
+    #: ``"continuous"`` (default) — slot-based in-flight decode over a
+    #: persistent grid; ``"batch"`` — the PR 4 batch-synchronous path.
+    scheduler: str = "continuous"
+    #: Decode-slot count for the continuous grid (None: the largest
+    #: batch bucket, so both schedulers size their device footprint the
+    #: same way).
+    num_slots: Optional[int] = None
+    #: Tokens decoded per chunk dispatch in continuous mode.  Small
+    #: chunks admit/retire at finer granularity (lower latency under
+    #: churn); large chunks amortize host dispatch overhead.
+    chunk_tokens: int = 8
     #: Sampling config shared by every request (static: it specializes
     #: the compiled decode program).  Default greedy.
     sample: "SampleConfig" = None  # type: ignore[assignment]
@@ -129,6 +167,19 @@ class ServeConfig:
                 f"admission must be 'block' or 'reject', "
                 f"got {self.admission!r}"
             )
+        if self.scheduler not in ("continuous", "batch"):
+            raise ValueError(
+                f"scheduler must be 'continuous' or 'batch', "
+                f"got {self.scheduler!r}"
+            )
+        if self.num_slots is None:
+            object.__setattr__(self, "num_slots", self.batch_buckets[-1])
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
+        if self.chunk_tokens < 1:
+            raise ValueError(
+                f"chunk_tokens must be >= 1, got {self.chunk_tokens}"
+            )
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
         if self.flush_deadline_s < 0:
@@ -143,7 +194,9 @@ class ServeResult:
     its ``max_new_tokens`` (eos included where sampled, pad after it) —
     byte-identical to ``generation.generate``'s row for the same prompt.
     ``num_generated`` counts real tokens (eos included).  The batch
-    fields record how the request was served (occupancy debugging).
+    fields record how the request was served (occupancy debugging);
+    under the continuous scheduler ``batch_size`` is the grid's
+    ``num_slots``.
     """
 
     tokens: np.ndarray
@@ -161,6 +214,19 @@ class _Request:
     bucket_len: int
     future: Future
     submitted: float  # perf_counter
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host mirror of one live decode slot (scheduler-thread only):
+    which request occupies it and the tokens emitted for it so far.
+    The device-side twin is the slot's row of the grid state
+    (``generation.init_slot_state``); host and device transition in
+    lockstep — both retire a slot exactly when its emission count hits
+    the request's ``max_new_tokens`` or the last emission was eos."""
+
+    request: _Request
+    tokens: List[int]
 
 
 class _Cell:
@@ -210,8 +276,9 @@ class _Cell:
 
 
 class ServingEngine:
-    """In-process dynamic-batching server over ``generation`` (module
-    docstring).  Construct, ``submit()`` concurrently from any thread,
+    """In-process continuous-batching server over ``generation`` (module
+    docstring; ``scheduler="batch"`` selects the batch-synchronous
+    path).  Construct, ``submit()`` concurrently from any thread,
     ``close()`` when done (or use as a context manager)."""
 
     def __init__(
@@ -257,11 +324,45 @@ class ServingEngine:
             "requests": 0, "completed": 0, "failed": 0, "rejected": 0,
             "batches": 0, "slots": 0, "real_rows": 0,
             "generated_tokens": 0,
+            # Token-level decode accounting, comparable across the two
+            # schedulers: useful emissions vs dispatched emission slots.
+            "decode_slot_steps": 0, "useful_decode_tokens": 0,
+            # Continuous-mode churn counters.
+            "inserts": 0, "retires": 0, "expired": 0, "chunks": 0,
         }
         self._qps = metrics.WindowedRate("serve/qps", window=16)
         self._tokens_rate = metrics.WindowedRate(
             "serve/tokens_per_sec", window=256
         )
+
+        self._continuous = self.serve_config.scheduler == "continuous"
+        if self._continuous:
+            cfg = self.serve_config
+            #: Slot cache rows must fit the largest bucket's prompt plus
+            #: the engine-wide decode budget.
+            self._max_len = cfg.prompt_buckets[-1] + cfg.max_new_tokens
+            self._grid_cache = generation.init_slot_cache(
+                config, cfg.num_slots, self._max_len, rules=self.rules,
+                mesh=self.mesh, kv_quant=cfg.kv_quant,
+            )
+            self._slot_state = generation.init_slot_state(
+                config, cfg.num_slots, sample=cfg.sample
+            )
+            #: Scheduler-thread-only slot bookkeeping (the host mirror).
+            self._slot_table: List[Optional[_Slot]] = [None] * cfg.num_slots
+            self._free_slots = list(range(cfg.num_slots))[::-1]
+            self._active_slots: set = set()
+            self._insert_cells: Dict[int, "compile_cache.AotStep"] = {}
+            #: Python-trace counters: the retrace guard for "one chunk
+            #: compile serves the whole run" (tests/helpers/retrace_guard
+            #: idiom — the wrapped body executes only while tracing).
+            self._chunk_traces = 0
+            self._insert_traces = 0
+            # Donating the grid through each dispatch keeps the cache
+            # update in place; CPU ignores donation with a warning, so
+            # only ask for it where the backend honors it.
+            self._donate = jax.default_backend() != "cpu"
+            self._chunk_step = self._make_chunk_step()
 
         if self.serve_config.warmup:
             self._start_warmup()
@@ -391,6 +492,62 @@ class ServingEngine:
 
     # -- warmup ------------------------------------------------------------
 
+    def _make_chunk_step(self):
+        """The single chunk-decode program: jitted once, optionally
+        AOT-warmed; every dispatch carries the same static shapes, so
+        one compile serves the engine's whole life (asserted via
+        ``_chunk_traces`` in the retrace-guard tests)."""
+        import jax
+
+        from cloud_tpu.models import generation
+        from cloud_tpu.training import compile_cache
+
+        cfg = self.serve_config
+
+        def chunk_fn(params, cache, state, rng):
+            self._chunk_traces += 1
+            return generation.decode_chunk_program(
+                params, cache, state, self.config,
+                chunk_size=cfg.chunk_tokens, sample=cfg.sample, rng=rng,
+                rules=self.rules, mesh=self.mesh,
+            )
+
+        donate = (1, 2) if self._donate else ()
+        return compile_cache.AotStep(
+            jax.jit(chunk_fn, donate_argnums=donate),
+            label="serve/decode_chunk",
+        )
+
+    def _insert_cell(self, bucket_len: int):
+        """The slot-insert program for one prompt bucket (compiled per
+        bucket length; ``prompt_len``/``slot``/``max_new_tokens`` are
+        traced scalars, so one executable serves every slot)."""
+        cell = self._insert_cells.get(bucket_len)
+        if cell is None:
+            import jax
+
+            from cloud_tpu.models import generation
+            from cloud_tpu.training import compile_cache
+
+            cfg = self.serve_config
+
+            def insert_fn(params, cache, state, tokens, prompt_len, slot,
+                          max_new, rng):
+                self._insert_traces += 1
+                return generation.insert_slot_program(
+                    params, cache, state, tokens, prompt_len, slot,
+                    max_new, self.config, sample=cfg.sample, rng=rng,
+                    rules=self.rules, mesh=self.mesh,
+                )
+
+            donate = (1, 2) if self._donate else ()
+            cell = compile_cache.AotStep(
+                jax.jit(insert_fn, donate_argnums=donate),
+                label=f"serve/insert_L{bucket_len}",
+            )
+            self._insert_cells[bucket_len] = cell
+        return cell
+
     def _start_warmup(self) -> None:
         """Queue AOT compiles for the whole grid on the compile-ahead
         worker (one background thread, in grid order — smallest programs
@@ -403,6 +560,23 @@ class ServingEngine:
         params_avals = compile_cache.abstract_state(self.params)
         context = compile_cache.context_key(mesh=self.mesh, rules=self.rules)
         rng_aval = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
+        if self._continuous:
+            cache_avals = compile_cache.abstract_state(self._grid_cache)
+            state_avals = compile_cache.abstract_state(self._slot_state)
+            scalar = jax.ShapeDtypeStruct((), np.int32)
+            jobs = []
+            for bucket_len in cfg.prompt_buckets:
+                cell = self._insert_cell(bucket_len)
+                tok_aval = jax.ShapeDtypeStruct((1, bucket_len), np.int32)
+                jobs.append((cell, (
+                    params_avals, cache_avals, state_avals, tok_aval,
+                    scalar, scalar, scalar, rng_aval,
+                ), context))
+            jobs.append((self._chunk_step, (
+                params_avals, cache_avals, state_avals, rng_aval,
+            ), context))
+            self._warmup_plan = compile_cache.start_compile_ahead(jobs)
+            return
         jobs = []
         for bucket_len in cfg.prompt_buckets:
             for batch_size in cfg.batch_buckets:
@@ -504,42 +678,260 @@ class ServingEngine:
 
     def _scheduler_loop(self) -> None:
         try:
-            while True:
-                with self._cond:
-                    while True:
-                        now = time.perf_counter()
-                        batch = self._pop_batch_locked(now)
-                        if batch is not None:
-                            self._waiting -= len(batch)
-                            self._cond.notify_all()  # admission space freed
-                            break
-                        if self._closed:
-                            return
-                        deadline = self._earliest_deadline_locked()
-                        timeout = (
-                            None if deadline is None
-                            else max(deadline - now, 1e-4)
-                        )
-                        self._cond.wait(timeout)
-                try:
-                    self._dispatch(batch)
-                except BaseException as exc:  # noqa: BLE001 — per-batch
-                    logger.exception("serving dispatch failed")
-                    metrics.counter_inc("serve/batch_errors")
-                    with self._stats_lock:
-                        self._stats["failed"] += len(batch)
-                    for request in batch:
-                        try:
-                            request.future.set_exception(exc)
-                        except InvalidStateError:  # pragma: no cover
-                            pass
+            if self._continuous:
+                self._continuous_loop()
+            else:
+                self._batch_loop()
         except BaseException as exc:  # noqa: BLE001 — scheduler must not
-            # die silently: fail everything still queued and refuse new work.
+            # die silently: fail everything still queued and in flight,
+            # and refuse new work.
             logger.exception("serving scheduler crashed")
             with self._cond:
                 self._closed = True
                 self._fail_pending_locked(exc)
                 self._cond.notify_all()
+            if self._continuous:
+                self._fail_live_slots(exc)
+
+    def _batch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    now = time.perf_counter()
+                    batch = self._pop_batch_locked(now)
+                    if batch is not None:
+                        self._waiting -= len(batch)
+                        self._cond.notify_all()  # admission space freed
+                        break
+                    if self._closed:
+                        return
+                    deadline = self._earliest_deadline_locked()
+                    timeout = (
+                        None if deadline is None
+                        else max(deadline - now, 1e-4)
+                    )
+                    self._cond.wait(timeout)
+            try:
+                self._dispatch(batch)
+            except BaseException as exc:  # noqa: BLE001 — per-batch
+                logger.exception("serving dispatch failed")
+                metrics.counter_inc("serve/batch_errors")
+                with self._stats_lock:
+                    self._stats["failed"] += len(batch)
+                for request in batch:
+                    try:
+                        request.future.set_exception(exc)
+                    except InvalidStateError:  # pragma: no cover
+                        pass
+
+    # -- continuous scheduler ----------------------------------------------
+
+    def _continuous_loop(self) -> None:
+        """Iteration-level scheduling: fill free slots from the queue,
+        run one chunk, retire what finished, repeat.  A dispatch failure
+        here is fatal to the grid (the cache/state pytrees may be
+        half-donated), so it propagates to the crash handler, which
+        fails every queued and in-flight request."""
+        while True:
+            inserts: List[Tuple[_Request, int]] = []
+            abort = False
+            with self._cond:
+                while True:
+                    if self._closed and not self._draining:
+                        abort = True
+                        break
+                    self._pop_inserts_locked(inserts)
+                    if inserts or self._active_slots:
+                        break
+                    if self._closed:
+                        return  # draining and nothing left to serve
+                    self._cond.wait()
+            if abort:
+                self._fail_live_slots(EngineClosedError(
+                    "engine closed without draining in-flight requests"
+                ))
+                return
+            try:
+                for idx, (request, slot) in enumerate(inserts):
+                    self._insert_request(request, slot)
+            except BaseException as exc:
+                # Requests popped from the queue but not yet in the slot
+                # table are invisible to the crash handler: fail them
+                # here (the in-flight one may already be tabled — its
+                # InvalidStateError is suppressed), then let the crash
+                # handler take the grid down.
+                failed = 0
+                for request, _ in inserts[idx:]:
+                    try:
+                        request.future.set_exception(exc)
+                        failed += 1
+                    except InvalidStateError:  # pragma: no cover
+                        pass
+                if failed:
+                    with self._stats_lock:
+                        self._stats["failed"] += failed
+                raise
+            if self._active_slots:
+                self._dispatch_chunk()
+
+    def _pop_inserts_locked(self, inserts) -> None:
+        """Claim one free slot per waiting request, oldest submit first
+        across every bucket (FIFO — a minority bucket cannot starve).
+        Caller holds the lock; dispatch happens outside it."""
+        popped = False
+        while self._free_slots:
+            oldest = None
+            oldest_queue = None
+            for queue_ in self._pending.values():
+                if queue_ and (
+                    oldest is None or queue_[0].submitted < oldest.submitted
+                ):
+                    oldest = queue_[0]
+                    oldest_queue = queue_
+            if oldest is None:
+                break
+            oldest_queue.popleft()
+            self._waiting -= 1
+            popped = True
+            inserts.append((oldest, self._free_slots.pop()))
+        if popped:
+            self._cond.notify_all()  # admission space freed
+
+    def _insert_request(self, request: _Request, slot: int) -> None:
+        import jax
+
+        cfg = self.serve_config
+        start = time.perf_counter()
+        tracing.record_span(
+            "serve/queue_wait", request.submitted, start,
+            bucket=request.bucket_len, slot=slot,
+        )
+        tokens = np.zeros((1, request.bucket_len), np.int32)
+        tokens[0, :request.prompt_len] = request.prompt
+        cell = self._insert_cell(request.bucket_len)
+        self._rng, insert_rng = jax.random.split(self._rng)
+        with tracing.span("serve/prefill", bucket=request.bucket_len,
+                          slot=slot):
+            self._grid_cache, self._slot_state, tok0 = cell(
+                self.params, self._grid_cache, self._slot_state, tokens,
+                np.int32(request.prompt_len), np.int32(slot),
+                np.int32(request.max_new_tokens), insert_rng,
+            )
+            tok0 = int(np.asarray(tok0))
+        self._slot_table[slot] = _Slot(request=request, tokens=[tok0])
+        with self._stats_lock:
+            self._stats["inserts"] += 1
+            self._stats["decode_slot_steps"] += 1  # the prefill emission
+            self._stats["useful_decode_tokens"] += 1
+        metrics.counter_inc("serve/slot_inserts")
+        eos = cfg.sample.eos_id
+        if request.max_new_tokens == 1 or (eos is not None and tok0 == eos):
+            # Finished at insert (mirrors the program's active0 gate).
+            self._retire_slot(slot)
+        else:
+            self._active_slots.add(slot)
+
+    def _dispatch_chunk(self) -> None:
+        import jax
+
+        cfg = self.serve_config
+        num_slots, chunk = cfg.num_slots, cfg.chunk_tokens
+        self._rng, chunk_rng = jax.random.split(self._rng)
+        with tracing.span(
+            "serve/chunk", slots=num_slots, chunk=chunk,
+            active=len(self._active_slots),
+        ) as chunk_span:
+            self._grid_cache, self._slot_state, toks, valid = (
+                self._chunk_step(
+                    self.params, self._grid_cache, self._slot_state,
+                    chunk_rng,
+                )
+            )
+            toks = np.asarray(toks)
+            valid = np.asarray(valid)
+            emitted = int(valid.sum())
+            occupancy = emitted / float(num_slots * chunk)
+            chunk_span.set_attribute("tokens", emitted)
+            chunk_span.set_attribute("occupancy", round(occupancy, 4))
+        metrics.counter_inc("serve/chunks")
+        metrics.gauge_set("serve/slot_occupancy", occupancy)
+        with self._stats_lock:
+            self._stats["chunks"] += 1
+            self._stats["decode_slot_steps"] += num_slots * chunk
+            self._stats["useful_decode_tokens"] += emitted
+        eos = cfg.sample.eos_id
+        for slot in sorted(self._active_slots):
+            entry = self._slot_table[slot]
+            for i in range(chunk):
+                if not valid[slot, i]:
+                    break
+                entry.tokens.append(int(toks[slot, i]))
+            hit_eos = eos is not None and entry.tokens[-1] == eos
+            if hit_eos or len(entry.tokens) >= entry.request.max_new_tokens:
+                self._retire_slot(slot)
+
+    def _retire_slot(self, slot: int, exc: Optional[BaseException] = None
+                     ) -> None:
+        """Free a slot and resolve its request's future — with the
+        result (the emitted row padded to the request's length) or, on
+        abort, the given exception."""
+        cfg = self.serve_config
+        entry = self._slot_table[slot]
+        self._slot_table[slot] = None
+        self._active_slots.discard(slot)
+        with self._cond:
+            self._free_slots.append(slot)
+        request = entry.request
+        if exc is not None:
+            try:
+                request.future.set_exception(exc)
+            except InvalidStateError:
+                # Already resolved elsewhere (e.g. the insert-failure
+                # handler beat us to it, or the caller cancelled): don't
+                # double-count the failure.
+                return
+            with self._stats_lock:
+                self._stats["failed"] += 1
+            return
+        m = request.max_new_tokens
+        num = min(len(entry.tokens), m)
+        row = np.full((m,), cfg.sample.pad_id, np.int32)
+        row[:num] = entry.tokens[:num]
+        done = time.perf_counter()
+        result = ServeResult(
+            tokens=row,
+            num_generated=num,
+            bucket_len=request.bucket_len,
+            batch_size=cfg.num_slots,
+            latency_seconds=done - request.submitted,
+        )
+        metrics.distribution_record(
+            "serve/latency_seconds", result.latency_seconds
+        )
+        metrics.counter_inc("serve/slot_retires")
+        metrics.counter_inc("serve/generated_tokens", num)
+        eos = cfg.sample.eos_id
+        hit_eos = eos is not None and num > 0 and int(row[num - 1]) == eos
+        if not hit_eos:
+            # The per-slot max_new_tokens cap (not eos) ended the slot.
+            metrics.counter_inc("serve/slot_expired")
+        self._qps.add(done, 1)
+        self._tokens_rate.add(done, num)
+        with self._stats_lock:
+            self._stats["retires"] += 1
+            if not hit_eos:
+                self._stats["expired"] += 1
+            self._stats["completed"] += 1
+            self._stats["generated_tokens"] += num
+        try:
+            request.future.set_result(result)
+        except InvalidStateError:  # pragma: no cover - cancelled
+            pass
+
+    def _fail_live_slots(self, exc: BaseException) -> None:
+        for slot, entry in enumerate(self._slot_table):
+            if entry is not None:
+                self._retire_slot(slot, exc=exc)
 
     def _dispatch(self, batch: List[_Request]) -> None:
         import jax
@@ -605,6 +997,14 @@ class ServingEngine:
             self._stats["real_rows"] += n
             self._stats["completed"] += n
             self._stats["generated_tokens"] += generated
+            # Token-level occupancy, comparable with the continuous
+            # scheduler: every dispatched row owes max_new_tokens
+            # emission slots whether or not a real request (or a short
+            # one) occupies it.
+            self._stats["decode_slot_steps"] += (
+                batch_size * cfg.max_new_tokens
+            )
+            self._stats["useful_decode_tokens"] += generated
         for request, result in zip(batch, results):
             try:
                 request.future.set_result(result)
@@ -614,11 +1014,29 @@ class ServingEngine:
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> dict:
-        """Counters snapshot + mean batch occupancy (real rows / dispatched
-        slots — the number the dynamic batcher is judged by)."""
+        """Counters snapshot plus the two occupancy quotients.
+
+        ``mean_batch_occupancy`` — real rows / dispatched rows (the PR 4
+        batch-formation number; 0.0 under the continuous scheduler).
+        ``mean_slot_occupancy`` — useful emitted tokens / dispatched
+        token slots, comparable ACROSS schedulers: it charges a batch
+        row for the full engine decode length and a continuous chunk
+        for every slot lane, so it is the number iteration-level
+        scheduling is judged by.
+        """
         with self._stats_lock:
             snap = dict(self._stats)
         snap["mean_batch_occupancy"] = (
             snap["real_rows"] / snap["slots"] if snap["slots"] else 0.0
         )
+        snap["mean_slot_occupancy"] = (
+            snap["useful_decode_tokens"] / snap["decode_slot_steps"]
+            if snap["decode_slot_steps"] else 0.0
+        )
         return snap
+
+    @property
+    def chunk_traces(self) -> int:
+        """Python-trace count of the chunk program (continuous mode): 1
+        after any amount of traffic == one compile served the run."""
+        return self._chunk_traces if self._continuous else 0

@@ -20,9 +20,11 @@ engineered quantity instead of an accident, three ways:
   :class:`AotStep` wrappers that dispatch through the ready executable
   (falling back to the plain jitted function on any input mismatch —
   compile-ahead can make a fit faster, never wrong).  The machinery is
-  not Trainer-specific: ``cloud_tpu.serving`` warms its whole
-  (bucket_len, batch_size) inference grid through the same registry +
-  worker at engine start (prefill/decode executables per grid cell).
+  not Trainer-specific: ``cloud_tpu.serving`` warms its whole inference
+  grid through the same registry + worker at engine start — one
+  slot-insert executable per prompt bucket plus the single chunk-decode
+  program under the continuous scheduler, or prefill/decode executables
+  per (bucket_len, batch_size) cell under the batch scheduler.
 * **Safe persistent cache** — :func:`maybe_enable_persistent_cache`
   re-enables jax's on-disk compilation cache behind
   ``CLOUD_TPU_COMPILE_CACHE=<dir>``, gated on a one-time child-process

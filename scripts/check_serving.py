@@ -1,22 +1,33 @@
 """End-to-end serving-engine check on CPU: parity, liveness, hygiene.
 
-Spins up a ``cloud_tpu.serving.ServingEngine`` in-process (TINY model,
-AOT-warmed two-bucket grid), fires N concurrent mixed-length requests
-from worker threads, and asserts the three contracts the engine makes:
+Spins up ``cloud_tpu.serving.ServingEngine`` in-process (TINY model,
+AOT-warmed), fires concurrent mixed-length requests from worker
+threads, and asserts the three contracts the engine makes — for BOTH
+schedulers:
 
 1. **Liveness** — every future resolves (no request stranded by the
-   batcher, the flush deadline, or shutdown).
+   batcher, the flush deadline, slot churn, or shutdown).
 2. **Parity** — each request's tokens are identical (token-for-token,
    greedy) to a direct unbatched ``generation.generate`` call for that
-   prompt alone: dynamic batching and bucket padding must be
+   prompt alone: batching, bucket padding, and slot scheduling must be
    observationally invisible.
 3. **Thread hygiene** — after ``close()``, no scheduler / compile-ahead
    worker threads survive.
 
+Phase 1 runs the PR 4 batch-synchronous path.  Phase 2 is the churn
+workload on the continuous scheduler: staggered arrivals from jittered
+worker threads, mixed prompt lengths AND per-request ``max_new_tokens``
+— maximum slot churn (insert-into-freed-slot, mid-chunk expiry, eos-free
+retire all exercised) — with the same parity oracle plus the
+one-chunk-compile retrace guard.  Both occupancies are REPORTED for
+trend-watching; the continuous-beats-batch assertion lives in
+tests/unit/test_serving.py, where the two schedulers run the identical
+workload (the two phases here deliberately differ).
+
 Prints one JSON line per phase plus a final summary::
 
     {"phase": "summary", "ok": true, "requests": ..., "batches": ...,
-     "mean_batch_occupancy": ..., ...}
+     "continuous_occupancy": ..., "leaked_threads": [], ...}
 
 Wired as a ``slow``-marked test in tests/unit/test_serving.py (the same
 pattern as scripts/check_cold_start.py), so CI runs it every time.
@@ -74,6 +85,7 @@ def main(argv=None) -> int:
         batch_buckets=(1, 2, 4),
         flush_deadline_s=0.02,
         warmup=True,
+        scheduler="batch",  # phase 1: the PR 4 baseline path
     )
     rng = np.random.default_rng(0)
     prompts = [
@@ -132,18 +144,100 @@ def main(argv=None) -> int:
         engine.close()
 
     leaked = _engine_threads()
+
+    # -- phase 2: churn workload on the continuous scheduler --------------
+    churn_serve = ServeConfig(
+        max_new_tokens=MAX_NEW,
+        prompt_buckets=(8, 16),
+        batch_buckets=(1, 2, 4),
+        chunk_tokens=2,
+        warmup=True,
+    )
+    churn_rng = np.random.default_rng(1)
+    churn_prompts = [
+        churn_rng.integers(1, 255, int(churn_rng.integers(2, 17))).astype(
+            np.int32
+        )
+        for _ in range(args.requests)
+    ]
+    churn_budgets = [
+        int(churn_rng.integers(1, MAX_NEW + 1)) for _ in churn_prompts
+    ]
+    churn_futures = [None] * len(churn_prompts)
+    churn_engine = ServingEngine(params, config, churn_serve, mesh=None)
+    try:
+        churn_engine.wait_ready()
+
+        def churn_submitter(i):
+            # Jittered arrival: requests land WHILE earlier ones decode,
+            # so slots churn instead of filling once.
+            time.sleep(float(i % 5) * 0.005)
+            churn_futures[i] = churn_engine.submit(
+                churn_prompts[i], max_new_tokens=churn_budgets[i]
+            )
+
+        churn_workers = [
+            threading.Thread(target=churn_submitter, args=(i,))
+            for i in range(len(churn_prompts))
+        ]
+        for w in churn_workers:
+            w.start()
+        for w in churn_workers:
+            w.join()
+        churn_results = [
+            f.result(timeout=args.timeout) for f in churn_futures
+        ]
+
+        churn_mismatches = 0
+        for prompt, budget, result in zip(churn_prompts, churn_budgets,
+                                          churn_results):
+            direct = generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([len(prompt)], np.int32), config,
+                max_new_tokens=budget,
+                sample=generation.SampleConfig(temperature=0.0),
+            )
+            want = np.asarray(direct["tokens"])[0]
+            if not np.array_equal(result.tokens, want) or (
+                result.num_generated != int(direct["num_generated"][0])
+            ):
+                churn_mismatches += 1
+        churn_stats = churn_engine.stats()
+    finally:
+        churn_engine.close()
+    print(json.dumps({
+        "phase": "churn",
+        "ok": churn_mismatches == 0,
+        "mismatches": churn_mismatches,
+        "inserts": churn_stats["inserts"],
+        "chunks": churn_stats["chunks"],
+        "continuous_occupancy": round(
+            churn_stats["mean_slot_occupancy"], 3
+        ),
+        "chunk_compiles": churn_engine.chunk_traces,
+    }), flush=True)
+    leaked_churn = _engine_threads()
+
     ok = (
-        mismatches == 0 and not leaked
+        mismatches == 0 and churn_mismatches == 0
+        and not leaked and not leaked_churn
         and stats["completed"] == len(prompts)
+        and churn_stats["completed"] == len(churn_prompts)
+        # The whole churn run — reuse, expiry, staggered inserts — must
+        # have retraced the chunk program exactly once.
+        and churn_engine.chunk_traces == 1
     )
     print(json.dumps({
         "phase": "summary",
         "ok": ok,
-        "requests": stats["requests"],
-        "completed": stats["completed"],
+        "requests": stats["requests"] + churn_stats["requests"],
+        "completed": stats["completed"] + churn_stats["completed"],
         "batches": stats["batches"],
         "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 3),
-        "leaked_threads": leaked,
+        "continuous_occupancy": round(
+            churn_stats["mean_slot_occupancy"], 3
+        ),
+        "leaked_threads": leaked + leaked_churn,
         "wall_seconds": round(time.perf_counter() - start, 3),
     }), flush=True)
     return 0 if ok else 1

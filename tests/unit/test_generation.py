@@ -542,6 +542,231 @@ class TestPromptLenValidation:
         )
 
 
+class TestSlotPrograms:
+    """The continuous-batching primitives (insert_slot_program /
+    decode_chunk_program) at the program level, engine-free: chunked
+    slot decode over a shared grid must be token-identical to
+    per-request generate(), including slot reuse over stale cache."""
+
+    def _model(self):
+        config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+        params = transformer.init(jax.random.PRNGKey(0), config)
+        return config, params
+
+    def _drive(self, params, config, sample, cache, state, chunk,
+               live):
+        """Run chunks until every slot is inactive, appending emissions
+        into ``live`` ({slot: token list})."""
+        while bool(np.asarray(state["active"]).any()):
+            cache, state, toks, valid = chunk(params, cache, state)
+            toks, valid = np.asarray(toks), np.asarray(valid)
+            for slot, tokens in live.items():
+                for i in range(toks.shape[1]):
+                    if valid[slot, i]:
+                        tokens.append(int(toks[slot, i]))
+        return cache, state
+
+    def test_chunked_slot_decode_matches_generate(self):
+        import functools
+
+        config, params = self._model()
+        sample = generation.SampleConfig(temperature=0.0)
+        rng = np.random.default_rng(0)
+        lens, budgets, bucket = (3, 6, 4), (5, 3, 1), 8
+        prompts = [rng.integers(1, 255, n).astype(np.int32) for n in lens]
+        num_slots, max_len = 3, bucket + 6
+
+        cache = generation.init_slot_cache(config, num_slots, max_len)
+        state = generation.init_slot_state(config, num_slots, sample=sample)
+        insert = jax.jit(functools.partial(
+            generation.insert_slot_program, config=config, sample=sample
+        ))
+        chunk = jax.jit(functools.partial(
+            generation.decode_chunk_program, config=config, chunk_size=2,
+            sample=sample,
+        ))
+        live = {}
+        for slot, (prompt, budget) in enumerate(zip(prompts, budgets)):
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(prompt)] = prompt
+            cache, state, tok0 = insert(
+                params, cache, state, padded, np.int32(len(prompt)),
+                np.int32(slot), np.int32(budget),
+            )
+            live[slot] = [int(tok0)]
+        # budget 1 never activates: finished at insert.
+        assert not bool(np.asarray(state["active"])[2])
+        self._drive(params, config, sample, cache, state, chunk, live)
+
+        for slot, (prompt, budget) in enumerate(zip(prompts, budgets)):
+            want = generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([len(prompt)], np.int32), config,
+                max_new_tokens=budget,
+            )
+            assert live[slot] == np.asarray(want["tokens"])[0].tolist(), slot
+
+    def test_slot_reuse_over_stale_cache(self):
+        """A slot that held a LONG sequence is re-inserted with a SHORT
+        prompt: the stale cache beyond the new prompt must never leak
+        (attention masks >= pos; decode overwrites before attending)."""
+        import functools
+
+        config, params = self._model()
+        sample = generation.SampleConfig(temperature=0.0)
+        rng = np.random.default_rng(1)
+        bucket, num_slots, max_len = 16, 2, 16 + 6
+
+        cache = generation.init_slot_cache(config, num_slots, max_len)
+        state = generation.init_slot_state(config, num_slots, sample=sample)
+        insert = jax.jit(functools.partial(
+            generation.insert_slot_program, config=config, sample=sample
+        ))
+        chunk = jax.jit(functools.partial(
+            generation.decode_chunk_program, config=config, chunk_size=3,
+            sample=sample,
+        ))
+
+        def serve_in_slot(prompt, budget, slot):
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(prompt)] = prompt
+            nonlocal cache, state
+            cache, state, tok0 = insert(
+                params, cache, state, padded, np.int32(len(prompt)),
+                np.int32(slot), np.int32(budget),
+            )
+            live = {slot: [int(tok0)]}
+            cache, state = self._drive(
+                params, config, sample, cache, state, chunk, live
+            )
+            return live[slot]
+
+        long_prompt = rng.integers(1, 255, 16).astype(np.int32)
+        short_prompt = rng.integers(1, 255, 2).astype(np.int32)
+        serve_in_slot(long_prompt, 6, 0)
+        got = serve_in_slot(short_prompt, 4, 0)  # same slot, shallow
+        want = generation.generate(
+            params, jnp.asarray(short_prompt[None, :]),
+            jnp.asarray([2], np.int32), config, max_new_tokens=4,
+        )
+        assert got == np.asarray(want["tokens"])[0].tolist()
+
+    def test_chunk_program_eos_and_min_new_tokens(self):
+        """eos deactivates a slot mid-chunk; min_new_tokens masks eos out
+        of the early steps — both matching generate()'s behavior."""
+        import functools
+
+        config, params = self._model()
+        prompt = np.asarray([7, 3, 11, 2], np.int32)
+        greedy = np.asarray(generation.generate(
+            params, jnp.asarray(prompt[None, :]),
+            jnp.asarray([4], np.int32), config, max_new_tokens=6,
+        )["tokens"])[0]
+        eos = int(greedy[1])
+        for min_new in (0, 4):
+            sample = generation.SampleConfig(
+                temperature=0.0, eos_id=eos, pad_id=0,
+                min_new_tokens=min_new,
+            )
+            cache = generation.init_slot_cache(config, 1, 8 + 6)
+            state = generation.init_slot_state(config, 1, sample=sample)
+            insert = jax.jit(functools.partial(
+                generation.insert_slot_program, config=config,
+                sample=sample,
+            ))
+            chunk = jax.jit(functools.partial(
+                generation.decode_chunk_program, config=config,
+                chunk_size=3, sample=sample,
+            ))
+            padded = np.zeros((1, 8), np.int32)
+            padded[0, :4] = prompt
+            cache, state, tok0 = insert(
+                params, cache, state, padded, np.int32(4), np.int32(0),
+                np.int32(6),
+            )
+            live = {0: [int(tok0)]}
+            self._drive(params, config, sample, cache, state, chunk, live)
+            want = generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([4], np.int32), config, max_new_tokens=6,
+                sample=sample,
+            )
+            want_row = np.asarray(want["tokens"])[0].tolist()
+            n = int(want["num_generated"][0])
+            assert live[0] == want_row[:n], (min_new, live[0], want_row)
+
+    def test_chunk_program_repetition_penalty_state(self):
+        """The seen-token mask rides the slot state: chunked decode with
+        a repetition penalty matches generate() under the same greedy
+        config (penalty applies to greedy too)."""
+        import functools
+
+        config, params = self._model()
+        sample = generation.SampleConfig(
+            temperature=0.0, repetition_penalty=1.3
+        )
+        prompt = np.asarray([5, 9, 17, 2], np.int32)
+        cache = generation.init_slot_cache(config, 2, 8 + 5)
+        state = generation.init_slot_state(config, 2, sample=sample)
+        assert "seen" in state
+        insert = jax.jit(functools.partial(
+            generation.insert_slot_program, config=config, sample=sample
+        ))
+        chunk = jax.jit(functools.partial(
+            generation.decode_chunk_program, config=config, chunk_size=2,
+            sample=sample,
+        ))
+        padded = np.zeros((1, 8), np.int32)
+        padded[0, :4] = prompt
+        cache, state, tok0 = insert(
+            params, cache, state, padded, np.int32(4), np.int32(1),
+            np.int32(5),
+        )
+        live = {1: [int(tok0)]}
+        self._drive(params, config, sample, cache, state, chunk, live)
+        want = generation.generate(
+            params, jnp.asarray(prompt[None, :]),
+            jnp.asarray([4], np.int32), config, max_new_tokens=5,
+            sample=sample,
+        )
+        assert live[1] == np.asarray(want["tokens"])[0].tolist()
+
+    def test_quantized_slot_grid_runs(self):
+        """kv_quant grids: insert writes int8 + scales, chunk decode
+        consumes them (parity is vs the quantized generate path)."""
+        import functools
+
+        config, params = self._model()
+        sample = generation.SampleConfig(temperature=0.0)
+        prompt = np.asarray([3, 1, 4, 1, 5], np.int32)
+        cache = generation.init_slot_cache(
+            config, 2, 8 + 4, kv_quant=True
+        )
+        assert "k_scale" in cache
+        state = generation.init_slot_state(config, 2, sample=sample)
+        insert = jax.jit(functools.partial(
+            generation.insert_slot_program, config=config, sample=sample
+        ))
+        chunk = jax.jit(functools.partial(
+            generation.decode_chunk_program, config=config, chunk_size=2,
+            sample=sample,
+        ))
+        padded = np.zeros((1, 8), np.int32)
+        padded[0, :5] = prompt
+        cache, state, tok0 = insert(
+            params, cache, state, padded, np.int32(5), np.int32(0),
+            np.int32(4),
+        )
+        live = {0: [int(tok0)]}
+        self._drive(params, config, sample, cache, state, chunk, live)
+        want = generation.generate(
+            params, jnp.asarray(prompt[None, :]),
+            jnp.asarray([5], np.int32), config, max_new_tokens=4,
+            kv_quant=True,
+        )
+        assert live[0] == np.asarray(want["tokens"])[0].tolist()
+
+
 class TestQuantizedKvCache:
     """kv_quant=True: int8 cache with per-(position, head) scales.  The
     post-scale attention algebra must equal explicit dequantization

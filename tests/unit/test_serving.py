@@ -1,14 +1,20 @@
-"""Serving-engine tests: dynamic batching must be observationally invisible.
+"""Serving-engine tests: scheduling must be observationally invisible.
 
-The load-bearing contract (ISSUE 4 acceptance): for a mixed-length
-request set, engine outputs are token-for-token identical (greedy) to
-per-request ``generation.generate`` calls — bucket padding, batch
-padding rows, and co-batching with strangers must never leak into a
-request's tokens.  Around that: batch formation (full-batch and
-deadline-flush paths), admission control (block/reject + typed errors),
-graceful drain on shutdown, AOT warmup through the compile-cache
-registry, and the same thread-hygiene guarantee as
-test_pipeline_engine — a closed engine owns zero live threads.
+The load-bearing contract (ISSUE 4 + ISSUE 6 acceptance): for a
+mixed-length request set — including staggered arrivals and mixed
+per-request decode budgets — engine outputs are token-for-token
+identical (greedy) to per-request ``generation.generate`` calls.
+Bucket padding, batch padding rows, co-batching with strangers, slot
+reuse over stale cache, and mid-chunk expiry must never leak into a
+request's tokens.  Around that: the continuous scheduler's slot
+lifecycle (insert-into-freed-slot, per-slot ``max_new_tokens`` expiry,
+drain of a partially full grid, one-chunk-compile retrace guard, and
+the occupancy win over the batch-synchronous baseline), batch-mode
+formation (full-batch and deadline-flush paths), admission control
+(block/reject + typed errors), graceful drain on shutdown, AOT warmup
+through the compile-cache registry, and the same thread-hygiene
+guarantee as test_pipeline_engine — a closed engine owns zero live
+threads.
 """
 
 import os
@@ -72,6 +78,7 @@ class TestParity:
         serve = ServeConfig(
             max_new_tokens=5, prompt_buckets=(8, 16),
             batch_buckets=(1, 2, 4), flush_deadline_s=0.02,
+            scheduler="batch",
         )
         rng = np.random.default_rng(0)
         prompts = [
@@ -170,7 +177,7 @@ class TestBatchFormation:
         config, params = model
         serve = ServeConfig(
             max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(4,),
-            flush_deadline_s=0.01,
+            flush_deadline_s=0.01, scheduler="batch",
         )
         with ServingEngine(params, config, serve) as engine:
             result = engine.submit(
@@ -187,7 +194,7 @@ class TestBatchFormation:
         config, params = model
         serve = ServeConfig(
             max_new_tokens=2, prompt_buckets=(8, 16), batch_buckets=(2,),
-            flush_deadline_s=0.0,
+            flush_deadline_s=0.0, scheduler="batch",
         )
         engine = ServingEngine(params, config, serve, start=False)
         minority = engine.submit(np.asarray(range(1, 10), np.int32))  # len 9
@@ -205,7 +212,7 @@ class TestBatchFormation:
         config, params = model
         serve = ServeConfig(
             max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(2,),
-            flush_deadline_s=30.0,
+            flush_deadline_s=30.0, scheduler="batch",
         )
         prompts = [np.asarray([1, 2], np.int32),
                    np.asarray([3, 4, 5], np.int32)]
@@ -327,7 +334,7 @@ class TestWarmup:
         before = compile_cache.registry_size()
         serve = ServeConfig(
             max_new_tokens=3, prompt_buckets=(8,), batch_buckets=(1, 2),
-            flush_deadline_s=0.0, warmup=True,
+            flush_deadline_s=0.0, warmup=True, scheduler="batch",
         )
         engine = ServingEngine(params, config, serve)
         engine.wait_ready()
@@ -354,7 +361,7 @@ class TestObservability:
         config, params = model
         serve = ServeConfig(
             max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(1, 2),
-            flush_deadline_s=0.0,
+            flush_deadline_s=0.0, scheduler="batch",
         )
         with tracing.collecting() as collector:
             with ServingEngine(params, config, serve) as engine:
@@ -370,6 +377,307 @@ class TestObservability:
         assert snap["counters"].get("serve/batches", 0) >= 1
         assert "serve/batch_occupancy" in snap["gauges"]
         assert "serve/latency_seconds" in snap["distributions"]
+
+
+class TestContinuous:
+    """The ISSUE 6 tentpole: slot-based in-flight decode.  Parity under
+    churn, slot lifecycle, drain, the one-chunk-compile retrace guard,
+    and the occupancy win over the batch-synchronous path."""
+
+    #: A churn workload: 10 ragged prompts across two buckets with mixed
+    #: per-request decode budgets — enough traffic that every slot of a
+    #: 4-slot grid is reused at least once.
+    CHURN_LENS = (3, 8, 12, 5, 16, 2, 7, 9, 4, 6)
+    CHURN_BUDGETS = (5, 2, 4, 1, 5, 3, 5, 2, 4, 5)
+
+    def _churn_prompts(self):
+        rng = np.random.default_rng(2)
+        return [
+            rng.integers(1, 255, n).astype(np.int32) for n in self.CHURN_LENS
+        ]
+
+    def _run_churn(self, params, config, serve, stagger=True):
+        """Submit the churn workload (staggered mid-stream unless told
+        otherwise), resolve everything, close, return (results, engine)."""
+        prompts = self._churn_prompts()
+        engine = ServingEngine(params, config, serve)
+        futures = []
+        for i, prompt in enumerate(prompts):
+            futures.append(
+                engine.submit(prompt, max_new_tokens=self.CHURN_BUDGETS[i])
+            )
+            if stagger and i in (3, 7):
+                time.sleep(0.05)  # arrivals land while earlier slots decode
+        results = [f.result(timeout=120) for f in futures]
+        engine.close()
+        return prompts, results, engine
+
+    def test_churn_parity_and_occupancy_beats_batch(self, model):
+        """The acceptance criterion: staggered arrivals, mixed prompt
+        AND output lengths — continuous outputs token-identical to
+        per-request generate(), and mean decode-slot occupancy beats the
+        SAME workload through the PR 4 batch-synchronous scheduler."""
+        config, params = model
+        continuous = ServeConfig(
+            max_new_tokens=5, prompt_buckets=(8, 16),
+            batch_buckets=(1, 2, 4), chunk_tokens=2,
+        )
+        prompts, results, engine = self._run_churn(
+            params, config, continuous
+        )
+        for prompt, budget, result in zip(prompts, self.CHURN_BUDGETS,
+                                          results):
+            want = _direct(params, config, prompt, budget)
+            np.testing.assert_array_equal(
+                result.tokens, np.asarray(want["tokens"])[0]
+            )
+            assert result.num_generated == int(want["num_generated"][0])
+        stats = engine.stats()
+        assert stats["completed"] == len(prompts)
+        assert stats["chunks"] > 0
+        assert 0 < stats["mean_slot_occupancy"] <= 1.0
+
+        batch = ServeConfig(
+            max_new_tokens=5, prompt_buckets=(8, 16),
+            batch_buckets=(1, 2, 4), flush_deadline_s=0.02,
+            scheduler="batch",
+        )
+        _, batch_results, batch_engine = self._run_churn(
+            params, config, batch
+        )
+        for result, batch_result in zip(results, batch_results):
+            np.testing.assert_array_equal(
+                result.tokens, batch_result.tokens
+            )
+        batch_stats = batch_engine.stats()
+        assert batch_stats["decode_slot_steps"] > 0
+        # The tentpole's reason to exist: iteration-level scheduling
+        # wastes fewer dispatched token slots on this workload.
+        assert (
+            stats["mean_slot_occupancy"] > batch_stats["mean_slot_occupancy"]
+        ), (stats, batch_stats)
+
+    def test_one_chunk_compile_serves_the_whole_run(self, model):
+        """Retrace guard (tests/helpers idiom, counted in the engine):
+        the whole churn run — slot reuse, mixed budgets, staggered
+        arrivals — retraces the chunk program exactly once, and each
+        prompt bucket's insert program once."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=5, prompt_buckets=(8, 16),
+            batch_buckets=(1, 2, 4), chunk_tokens=2,
+        )
+        _, _, engine = self._run_churn(params, config, serve)
+        assert engine.stats()["inserts"] == len(self.CHURN_LENS)
+        assert engine.chunk_traces == 1
+        assert engine._insert_traces <= len(serve.prompt_buckets)
+
+    def test_insert_into_freed_slot_reuses_stale_cache_rows(self, model):
+        """More requests than slots: every completion frees a slot that
+        a LATER, differently-shaped request re-prefills; stale cache
+        from the previous occupant must never leak into its tokens."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=4, prompt_buckets=(8, 16),
+            batch_buckets=(1, 2), num_slots=2, chunk_tokens=2,
+        )
+        rng = np.random.default_rng(3)
+        # Long prompts first (fill the cache rows deep), short after
+        # (reuse the same rows shallow).
+        lens = (16, 12, 3, 2, 5)
+        prompts = [rng.integers(1, 255, n).astype(np.int32) for n in lens]
+        with ServingEngine(params, config, serve) as engine:
+            futures = [engine.submit(p) for p in prompts]
+            results = [f.result(timeout=120) for f in futures]
+            stats = engine.stats()
+        for prompt, result in zip(prompts, results):
+            want = _direct(params, config, prompt, 4)
+            np.testing.assert_array_equal(
+                result.tokens, np.asarray(want["tokens"])[0]
+            )
+        # 5 requests through 2 slots: slots were necessarily reused.
+        assert stats["inserts"] == 5 > serve.num_slots
+
+    def test_per_slot_budget_expires_mid_chunk(self, model):
+        """A slot whose per-request max_new_tokens runs out mid-chunk
+        deactivates there (the active mask), emits nothing further, and
+        its neighbor decodes on unaffected."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=6, prompt_buckets=(8,), batch_buckets=(2,),
+            chunk_tokens=4,
+        )
+        short = np.asarray([5, 9, 17, 2], np.int32)
+        long_ = np.asarray([3, 1, 4, 1, 5], np.int32)
+        engine = ServingEngine(params, config, serve, start=False)
+        # budget 2: tok0 at insert + 1 chunk emission — expires at chunk
+        # step 1 of 4, mid-chunk by construction.
+        short_future = engine.submit(short, max_new_tokens=2)
+        long_future = engine.submit(long_, max_new_tokens=6)
+        engine.start()
+        short_result = short_future.result(timeout=120)
+        long_result = long_future.result(timeout=120)
+        engine.close()
+        want_short = _direct(params, config, short, 2)
+        want_long = _direct(params, config, long_, 6)
+        np.testing.assert_array_equal(
+            short_result.tokens, np.asarray(want_short["tokens"])[0]
+        )
+        np.testing.assert_array_equal(
+            long_result.tokens, np.asarray(want_long["tokens"])[0]
+        )
+        assert short_result.num_generated == 2
+        assert engine.stats()["expired"] >= 1
+
+    def test_eos_retires_slot_early(self, model):
+        """eos parity through the continuous path: the eos is emitted,
+        the row pads after it, num_generated counts through the eos —
+        and the slot frees early (no expiry counted)."""
+        config, params = model
+        prompt = np.asarray([7, 3, 11, 2], np.int32)
+        greedy = np.asarray(_direct(params, config, prompt, 6)["tokens"])[0]
+        eos = int(greedy[1])
+        sample = generation.SampleConfig(temperature=0.0, eos_id=eos,
+                                         pad_id=0)
+        serve = ServeConfig(
+            max_new_tokens=6, prompt_buckets=(8,), batch_buckets=(1, 2),
+            chunk_tokens=3, sample=sample,
+        )
+        with ServingEngine(params, config, serve) as engine:
+            result = engine.submit(prompt).result(timeout=120)
+            stats = engine.stats()
+        want = _direct(params, config, prompt, 6, sample=sample)
+        np.testing.assert_array_equal(
+            result.tokens, np.asarray(want["tokens"])[0]
+        )
+        assert result.num_generated == int(want["num_generated"][0]) == 2
+        assert stats["retires"] == 1
+        assert stats["expired"] == 0  # eos retired it, not the budget cap
+
+    def test_close_drains_partially_full_grid(self, model):
+        """close() on a grid with free slots still serves every admitted
+        request to completion before the scheduler exits."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=8, prompt_buckets=(8,), batch_buckets=(4,),
+            chunk_tokens=2,
+        )
+        engine = ServingEngine(params, config, serve)
+        futures = [
+            engine.submit(np.asarray([1, 2, i], np.int32))
+            for i in range(1, 3)  # 2 requests in a 4-slot grid
+        ]
+        engine.close()  # drain=True default
+        for f in futures:
+            assert f.result(timeout=5).num_generated == 8
+        assert engine.stats()["completed"] == 2
+        assert not _engine_threads()
+
+    def test_close_without_drain_fails_in_flight(self, model):
+        """close(drain=False) resolves in-flight slot requests promptly
+        (with EngineClosedError, unless they won the race and finished)
+        instead of serving the grid to completion."""
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=32, prompt_buckets=(8,), batch_buckets=(1,),
+            chunk_tokens=1,
+        )
+        engine = ServingEngine(params, config, serve)
+        future = engine.submit(np.asarray([1, 2, 3], np.int32))
+        engine.close(drain=False)
+        assert future.done()
+        try:
+            result = future.result(timeout=5)
+        except EngineClosedError:
+            pass  # the expected path: aborted mid-decode
+        else:  # raced to completion before close landed: still valid
+            assert result.num_generated == 32
+        assert not _engine_threads()
+
+    def test_continuous_warmup_precompiles_grid(self, model):
+        """warmup=True lands one insert executable per prompt bucket
+        plus THE chunk executable in the AOT registry before traffic,
+        and the warmed dispatch still matches the oracle with exactly
+        one chunk trace."""
+        from cloud_tpu.training import compile_cache
+
+        config, params = model
+        before = compile_cache.registry_size()
+        serve = ServeConfig(
+            max_new_tokens=3, prompt_buckets=(8, 16), batch_buckets=(1, 2),
+            chunk_tokens=2, warmup=True,
+        )
+        engine = ServingEngine(params, config, serve)
+        engine.wait_ready()
+        assert engine._warmup_plan.error is None
+        # 2 insert programs + 1 chunk program = 3 new entries.
+        assert compile_cache.registry_size() >= before + 3
+        assert engine._chunk_step.compiled is not None
+        for bucket in serve.prompt_buckets:
+            assert engine._insert_cells[bucket].compiled is not None
+
+        prompt = np.asarray([9, 4, 1], np.int32)
+        result = engine.submit(prompt).result(timeout=120)
+        engine.close()
+        want = _direct(params, config, prompt, 3)
+        np.testing.assert_array_equal(
+            result.tokens, np.asarray(want["tokens"])[0]
+        )
+        assert engine.chunk_traces == 1
+
+    def test_continuous_spans_and_metrics(self, model):
+        from cloud_tpu.monitoring import metrics, tracing
+
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=4, prompt_buckets=(8,), batch_buckets=(1, 2),
+            chunk_tokens=2,
+        )
+        with tracing.collecting() as collector:
+            with ServingEngine(params, config, serve) as engine:
+                engine.submit(
+                    np.asarray([1, 2, 3], np.int32)
+                ).result(timeout=120)
+        agg = collector.aggregates()
+        for name in ("serve/queue_wait", "serve/prefill", "serve/chunk"):
+            assert agg.get(name, {}).get("count", 0) >= 1, name
+        chunk_events = [
+            e for e in collector.events() if e["name"] == "serve/chunk"
+        ]
+        assert chunk_events
+        args = chunk_events[0]["args"]
+        assert args["slots"] == serve.num_slots
+        assert 0 < args["occupancy"] <= 1.0
+        snap = metrics.snapshot()
+        assert snap["counters"].get("serve/slot_inserts", 0) >= 1
+        assert snap["counters"].get("serve/slot_retires", 0) >= 1
+        assert snap["counters"].get("serve/chunks", 0) >= 1
+        assert "serve/slot_occupancy" in snap["gauges"]
+
+    def test_continuous_report_breakdown(self, model):
+        """The report CLI renders a continuous-batching grid-health line
+        from the chunk spans' attributes."""
+        from cloud_tpu.monitoring import tracing
+        from cloud_tpu.monitoring.report import TraceReport
+
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=4, prompt_buckets=(8,), batch_buckets=(1, 2),
+            chunk_tokens=2,
+        )
+        with tracing.collecting() as collector:
+            with ServingEngine(params, config, serve) as engine:
+                engine.submit(
+                    np.asarray([4, 5, 6], np.int32)
+                ).result(timeout=120)
+            report = TraceReport(collector.events())
+        summary = report.continuous_summary()
+        assert summary is not None
+        assert summary["chunks"] >= 1
+        assert 0 < summary["mean_occupancy"] <= 1.0
+        rendered = report.render()
+        assert "continuous batching:" in rendered
+        assert "serve/chunk" in rendered
 
 
 @pytest.mark.slow
