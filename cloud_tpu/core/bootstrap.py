@@ -45,6 +45,22 @@ def main(argv=None) -> None:
 
     os.environ[ENV_RUNNING_REMOTELY] = "1"
 
+    # Preemption drain: Cloud TPU evictions deliver SIGTERM with a grace
+    # window; the handler sets a stop event Trainer.fit checks at every
+    # dispatch boundary, so training checkpoints and exits (status
+    # PREEMPTION_EXIT_CODE below) instead of dying mid-step.
+    from cloud_tpu.training import preemption
+
+    preemption.install_sigterm_handler()
+
+    # Chaos parity across processes: a fault plan exported by
+    # faults.inject() in the submitting/test process
+    # (CLOUD_TPU_FAULT_PLAN) is re-installed here, so a bootstrapped
+    # child or the cloud_fit server injects the same plan.
+    from cloud_tpu.utils import faults
+
+    faults.maybe_install_from_env()
+
     from cloud_tpu.parallel import distributed
 
     distributed.initialize_from_env()
@@ -91,6 +107,7 @@ def main(argv=None) -> None:
     if args.distribution_strategy == "none":
         # User-owned parallelism (reference validate.py:117-124 None path).
         runpy.run_path(entry_point, run_name="__main__")
+        _exit_if_drained()
         return
 
     import jax
@@ -106,6 +123,23 @@ def main(argv=None) -> None:
     mesh = plan.build()
     with mesh_lib.use_mesh(mesh):
         runpy.run_path(entry_point, run_name="__main__")
+    _exit_if_drained()
+
+
+def _exit_if_drained() -> None:
+    """Exit with the distinct preemption status when the user script
+    finished BECAUSE the drain stop event fired: the supervisor (and any
+    orchestrator reading exit codes) can tell "checkpointed and yielded
+    to preemption" (143) apart from success (0) and a crash (!= 0,
+    != 143) — the recreate path resumes from the drained checkpoint."""
+    from cloud_tpu.training import preemption
+
+    if preemption.stop_requested():
+        logger.warning(
+            "bootstrap exiting with preemption-drain status %d (%s)",
+            preemption.PREEMPTION_EXIT_CODE, preemption.stop_reason(),
+        )
+        sys.exit(preemption.PREEMPTION_EXIT_CODE)
 
 
 if __name__ == "__main__":

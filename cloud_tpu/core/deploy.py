@@ -19,7 +19,7 @@ from typing import Callable, Dict, List, Optional
 
 from cloud_tpu.core import gcp, machine_config
 from cloud_tpu.parallel import planner
-from cloud_tpu.utils import api_client
+from cloud_tpu.utils import api_client, retries
 
 logger = logging.getLogger(__name__)
 
@@ -32,6 +32,25 @@ _LRO_POLL_ATTEMPTS = 60
 #: provisioning wait, 40 x 10 s (preprocess.py:238-261).
 _READY_POLL_INTERVAL_SECONDS = 10
 _READY_POLL_ATTEMPTS = 40
+
+
+def _poll_sleep(sleep: Callable[[float], None], seconds: float) -> None:
+    """All fixed-interval poll waits go through here, ±20% jittered:
+    recreated multi-node jobs boot near-simultaneously, and without
+    jitter their supervisors/awaits poll the API in lockstep forever.
+    The injectable ``sleep`` seam is preserved (tests stay instant and
+    can assert the base interval from the jittered value)."""
+    sleep(retries.jittered(seconds))
+
+
+def _deploy_retry_policy(sleep: Callable[[float], None]) -> retries.RetryPolicy:
+    """Deploy-layer policy over the SESSION's own retries: coarser
+    backoff for polls that may legitimately run for minutes, threaded
+    through the same injectable ``sleep`` the poll loops use."""
+    return retries.default_api_policy(
+        max_attempts=5, initial_backoff_s=1.0, max_backoff_s=20.0,
+        max_elapsed_s=120.0, sleep=sleep,
+    )
 
 
 class ProvisioningError(RuntimeError):
@@ -224,12 +243,19 @@ def deploy_job(
     request: Optional[dict] = None,
     wait_for_ready: bool = True,
     sleep: Callable[[float], None] = time.sleep,
+    retry: Optional[retries.RetryPolicy] = None,
 ) -> dict:
     """Create the TPU nodes for the job; returns job info incl. console URL.
 
     ``request`` may carry a prebuilt ``build_job_request`` result (run()
     builds one for its report; passing it here guarantees the submitted
     nodes are exactly the reported ones).
+
+    ``retry`` (default: a deploy-grade :class:`retries.RetryPolicy`)
+    absorbs transient API failures — 429/5xx/transport, surfaced as
+    typed :class:`api_client.ApiTransientError` — around every submit
+    POST and status poll, on top of whatever the session itself retries;
+    permanent 4xx still fails (and rolls back) on the first attempt.
 
     Lifecycle (the part the reference delegated to CAIP's managed
     ``cloud_tpu`` worker — SURVEY.md §7 hard parts): each create's LRO is
@@ -258,25 +284,28 @@ def deploy_job(
             job_labels=job_labels, service_account=service_account,
             monitoring=monitoring, profiler_port=profiler_port,
         )
+    retry = retry if retry is not None else _deploy_retry_policy(sleep)
     parent = f"projects/{project}/locations/{zone}"
     created: List[str] = []
     try:
         operations = {}
         for node_id, body in request["nodes"].items():
-            op = session.post(
-                f"{_TPU_API}/{parent}/nodes", body=body,
-                params={"nodeId": node_id},
-            )
+            # Appended BEFORE the POST: if the request reaches the API
+            # but the response is lost (ambiguous transient), the node
+            # may exist server-side — rollback must try to delete it
+            # (a 404 for a never-created node is best-effort-swallowed).
             created.append(node_id)
+            op = _create_node(session, parent, node_id, body, retry)
             operations[node_id] = op
             logger.info(
                 "creating TPU node %s (%s)", node_id, body["acceleratorType"]
             )
         if wait_for_ready:
             for node_id, op in operations.items():
-                _await_operation(session, op, node_id, sleep=sleep)
+                _await_operation(session, op, node_id, sleep=sleep,
+                                 retry=retry)
                 _await_node_ready(
-                    session, parent, node_id, sleep=sleep
+                    session, parent, node_id, sleep=sleep, retry=retry
                 )
     except Exception as exc:
         logger.error("provisioning failed (%s); rolling back %d node(s)",
@@ -302,14 +331,63 @@ def deploy_job(
     }
 
 
+def _create_node(session, parent: str, node_id: str, body: dict,
+                 retry: retries.RetryPolicy) -> dict:
+    """One node-create, retried under ``retry`` and 409-tolerant AFTER a
+    transient.
+
+    Node creation is not idempotent: if an attempt's request reached the
+    API before its response was lost, the retry gets 409 ALREADY_EXISTS
+    — which would classify as a permanent failure and (in deploy_job)
+    roll back healthy slices, or (in supervise_job) burn a restart for a
+    node that exists.  A 409 is treated as created ONLY when an earlier
+    attempt of THIS call failed transiently — a first-attempt 409 (a
+    stale node from a caller-supplied job id) still raises and rolls
+    back, because adopting a READY node running the OLD workload would
+    report success for a job that never started.  The empty op
+    short-circuits ``_await_operation``; the READY await then validates
+    the node for real.
+    """
+    saw_transient: List[BaseException] = []
+
+    def attempt() -> dict:
+        try:
+            return session.post(
+                f"{_TPU_API}/{parent}/nodes", body=body,
+                params={"nodeId": node_id},
+            )
+        except api_client.ApiTransientError:
+            saw_transient.append(True)
+            raise
+        except api_client.ApiError as exc:
+            if exc.status == 409 and saw_transient:
+                logger.info(
+                    "node %s already exists after a retried create (the "
+                    "lost attempt landed); proceeding to READY await",
+                    node_id,
+                )
+                return {}
+            raise
+
+    return retry.call(attempt, name="node_create")
+
+
 def _await_operation(
-    session, op: dict, node_id: str, *, sleep: Callable[[float], None]
+    session, op: dict, node_id: str, *, sleep: Callable[[float], None],
+    retry: Optional[retries.RetryPolicy] = None,
 ) -> dict:
-    """Poll a TPU v2 long-running operation until done (bounded)."""
+    """Poll a TPU v2 long-running operation until done (bounded).
+
+    A transient failure of one status GET retries under ``retry``
+    instead of aborting provisioning (and rolling back healthy slices)
+    over a blip; the poll-interval sleeps are jittered so concurrent
+    awaits don't hit the API in lockstep.
+    """
     name = op.get("name")
     if not name:
         # Some fakes/environments return the node body directly.
         return op
+    retry = retry if retry is not None else _deploy_retry_policy(sleep)
     for _ in range(_LRO_POLL_ATTEMPTS):
         if op.get("done"):
             if "error" in op:
@@ -317,8 +395,10 @@ def _await_operation(
                     f"node {node_id} create operation failed: {op['error']}"
                 )
             return op
-        sleep(_LRO_POLL_INTERVAL_SECONDS)
-        op = session.get(f"{_TPU_API}/{name}")
+        _poll_sleep(sleep, _LRO_POLL_INTERVAL_SECONDS)
+        op = retry.call(
+            lambda: session.get(f"{_TPU_API}/{name}"), name="operation_poll"
+        )
     raise ProvisioningError(
         f"node {node_id} create operation {name!r} not done after "
         f"{_LRO_POLL_ATTEMPTS * _LRO_POLL_INTERVAL_SECONDS}s"
@@ -326,12 +406,17 @@ def _await_operation(
 
 
 def _await_node_ready(
-    session, parent: str, node_id: str, *, sleep: Callable[[float], None]
+    session, parent: str, node_id: str, *, sleep: Callable[[float], None],
+    retry: Optional[retries.RetryPolicy] = None,
 ) -> dict:
     """Poll the node until state == READY (reference budget 40 x 10 s)."""
     node = {}
+    retry = retry if retry is not None else _deploy_retry_policy(sleep)
     for attempt in range(_READY_POLL_ATTEMPTS):
-        node = session.get(f"{_TPU_API}/{parent}/nodes/{node_id}")
+        node = retry.call(
+            lambda: session.get(f"{_TPU_API}/{parent}/nodes/{node_id}"),
+            name="node_ready_poll",
+        )
         state = node.get("state")
         if state == "READY":
             logger.info("TPU node %s READY", node_id)
@@ -341,7 +426,7 @@ def _await_node_ready(
                 f"node {node_id} entered terminal state {state}"
             )
         if attempt + 1 < _READY_POLL_ATTEMPTS:
-            sleep(_READY_POLL_INTERVAL_SECONDS)
+            _poll_sleep(sleep, _READY_POLL_INTERVAL_SECONDS)
     raise ProvisioningError(
         f"node {node_id} not READY after "
         f"{_READY_POLL_ATTEMPTS * _READY_POLL_INTERVAL_SECONDS}s "
@@ -368,6 +453,7 @@ def supervise_job(
     max_restarts: int = 3,
     should_stop: Optional[Callable[[], bool]] = None,
     sleep: Callable[[float], None] = time.sleep,
+    retry: Optional[retries.RetryPolicy] = None,
 ) -> dict:
     """Watch a running job's nodes and recreate any that get preempted.
 
@@ -389,10 +475,14 @@ def supervise_job(
     True — or until every node has been deleted out from under it
     (``delete_job`` from anywhere, console teardown), which is the normal
     end-of-job signal; returns ``{"restarts": {node_id: count}}``.
-    Transient API errors on the state poll are logged and retried next
-    round, never fatal — this loop may run for days.
+    Transient API errors on the state poll retry inline under ``retry``
+    (typed classification — the ``utils.retries`` policy), and even an
+    exhausted retry budget only skips to the next round, never fatal —
+    this loop may run for days.  Poll sleeps are jittered (±20%) so the
+    supervisors of a recreated multi-node job don't poll in lockstep.
     """
     session = session or api_client.default_session()
+    retry = retry if retry is not None else _deploy_retry_policy(sleep)
     parent = f"projects/{job_info['project']}/locations/{job_info['zone']}"
     restarts: Dict[str, int] = {}
     watching = list(job_info["nodes"])
@@ -421,13 +511,15 @@ def supervise_job(
         except (api_client.ApiError, ProvisioningError):
             logger.info("delete of %s failed (already gone?)", node_id)
         try:
-            op = session.post(
-                f"{_TPU_API}/{parent}/nodes",
-                body=request["nodes"][node_id],
-                params={"nodeId": node_id},
+            # Same ambiguity handling as deploy_job's creates: a 409
+            # after a transient means the lost recreate landed — await
+            # it READY instead of burning another restart on it.
+            op = _create_node(
+                session, parent, node_id, request["nodes"][node_id], retry
             )
-            _await_operation(session, op, node_id, sleep=sleep)
-            _await_node_ready(session, parent, node_id, sleep=sleep)
+            _await_operation(session, op, node_id, sleep=sleep, retry=retry)
+            _await_node_ready(session, parent, node_id, sleep=sleep,
+                              retry=retry)
             recreate_pending.discard(node_id)
         except Exception:  # noqa: BLE001 — the budget raise is earlier
             # The replacement died too (preempted while provisioning,
@@ -443,7 +535,12 @@ def supervise_job(
             if should_stop and should_stop():
                 break
             try:
-                node = session.get(f"{_TPU_API}/{parent}/nodes/{node_id}")
+                node = retry.call(
+                    lambda: session.get(
+                        f"{_TPU_API}/{parent}/nodes/{node_id}"
+                    ),
+                    name="supervise_poll",
+                )
             except api_client.ApiError as exc:
                 if exc.status == 404:
                     if node_id in recreate_pending:
@@ -476,7 +573,7 @@ def supervise_job(
             break
         if should_stop and should_stop():
             break
-        sleep(poll_seconds)
+        _poll_sleep(sleep, poll_seconds)
     return {"restarts": restarts}
 
 
@@ -503,6 +600,7 @@ def stream_logs(
     should_stop: Optional[Callable[[], bool]] = None,
     sleep: Callable[[float], None] = time.sleep,
     out: Callable[[str], None] = print,
+    retry: Optional[retries.RetryPolicy] = None,
 ) -> int:
     """Continuously stream the job's TPU-worker logs (Cloud Logging REST).
 
@@ -510,9 +608,12 @@ def stream_logs(
     ai-platform jobs stream-logs`` (blocking follow).  Here the follow loop
     is framework-owned: poll ``entries:list`` with a timestamp cursor so
     each round prints only new entries, forever until ``should_stop`` says
-    otherwise (or Ctrl-C).  Returns the number of entries printed.
+    otherwise (or Ctrl-C).  A transient Logging-API failure retries under
+    ``retry`` (the cursor is untouched, so nothing is skipped or
+    reprinted).  Returns the number of entries printed.
     """
     session = session or api_client.default_session()
+    retry = retry if retry is not None else _deploy_retry_policy(sleep)
     base_filter = (
         f'resource.type="tpu_worker" AND labels.cloud_tpu_job="{job_id}"'
     )
@@ -523,14 +624,17 @@ def stream_logs(
             log_filter = base_filter + (
                 f' AND timestamp>"{cursor}"' if cursor else ""
             )
-            resp = session.post(
-                f"{_LOGGING_API}/entries:list",
-                body={
-                    "resourceNames": [f"projects/{project}"],
-                    "filter": log_filter,
-                    "orderBy": "timestamp asc",
-                    "pageSize": 1000,
-                },
+            resp = retry.call(
+                lambda log_filter=log_filter: session.post(
+                    f"{_LOGGING_API}/entries:list",
+                    body={
+                        "resourceNames": [f"projects/{project}"],
+                        "filter": log_filter,
+                        "orderBy": "timestamp asc",
+                        "pageSize": 1000,
+                    },
+                ),
+                name="log_poll",
             )
             for entry in resp.get("entries", []):
                 payload = entry.get("textPayload")
@@ -543,7 +647,7 @@ def stream_logs(
                 cursor = entry.get("timestamp", cursor)
             if should_stop is not None and should_stop():
                 return printed
-            sleep(poll_seconds)
+            _poll_sleep(sleep, poll_seconds)
     except KeyboardInterrupt:
         logger.info("log streaming interrupted")
         return printed

@@ -16,6 +16,14 @@ Continuous-batching timelines (``serve/chunk`` spans) additionally get
 a grid-health line: chunk count, mean slot occupancy, mean active
 slots, and total emitted tokens, aggregated from the per-dispatch span
 attributes the scheduler stamps on every chunk.
+
+Timelines touched by the fault-tolerance layer get a **robustness**
+section: retry activity (``retry/*`` spans — the ``utils.retries``
+policy stamps ``attempts``/``outcome`` on every retried call), shed /
+deadline-exceeded serving requests (``serve/shed``), injected chaos
+faults (``fault/<site>`` spans from ``utils.faults``), and preemption
+drains (``preempt/drain``) — so a post-mortem of "what went wrong and
+what absorbed it" reads off the same CLI as the latency breakdown.
 """
 
 from __future__ import annotations
@@ -142,6 +150,49 @@ class TraceReport:
             row["pct_serve"] = 100.0 * row["total_s"] / total if total else 0.0
         return rows
 
+    def robustness_summary(self) -> Optional[Dict[str, object]]:
+        """Aggregate the fault-tolerance spans into one post-mortem dict.
+
+        ``retries``: per-``retry/<name>`` — calls that needed retrying,
+        total attempts, and give-ups (from the ``attempts``/``outcome``
+        attributes the policy stamps; first-try successes record no
+        span, so these are exactly the interesting calls).
+        ``shed``: deadline-exceeded serving requests (``serve/shed``).
+        ``faults``: injected chaos faults per site (``fault/<site>``).
+        ``drains``: preemption drains (``preempt/drain``).  None when
+        the timeline shows no robustness activity at all.
+        """
+        retries: Dict[str, Dict[str, int]] = {}
+        faults: Dict[str, int] = {}
+        shed = 0
+        drains = 0
+        for event in self.events:
+            name = event.get("name", "")
+            args = event.get("args") or {}
+            if name.startswith("retry/"):
+                row = retries.setdefault(
+                    name[len("retry/"):],
+                    {"calls": 0, "attempts": 0, "gave_up": 0},
+                )
+                row["calls"] += 1
+                attempts = args.get("attempts")
+                if isinstance(attempts, (int, float)):
+                    row["attempts"] += int(attempts)
+                if args.get("outcome") == "gave_up":
+                    row["gave_up"] += 1
+            elif name == "serve/shed":
+                shed += 1
+            elif name.startswith("fault/"):
+                faults[name[len("fault/"):]] = (
+                    faults.get(name[len("fault/"):], 0) + 1
+                )
+            elif name == "preempt/drain":
+                drains += 1
+        if not retries and not faults and not shed and not drains:
+            return None
+        return {"retries": retries, "shed": shed, "faults": faults,
+                "drains": drains}
+
     @staticmethod
     def _render_table(rows, header) -> List[str]:
         table = [header] + rows
@@ -189,6 +240,29 @@ class TraceReport:
                 for r in serve_rows
             ], ("phase", "count", "total", "mean", "p50", "max",
                 "% serve")))
+        robustness = self.robustness_summary()
+        if robustness:
+            lines.append("")
+            lines.append("robustness (retries, shedding, faults, drains):")
+            for name, row in sorted(robustness["retries"].items()):
+                detail = (
+                    f"  retry/{name}: {row['calls']} retried call(s), "
+                    f"{row['attempts']} attempts"
+                )
+                if row["gave_up"]:
+                    detail += f", {row['gave_up']} gave up"
+                lines.append(detail)
+            if robustness["shed"]:
+                lines.append(
+                    f"  shed requests (deadline exceeded): "
+                    f"{robustness['shed']}"
+                )
+            for site, count in sorted(robustness["faults"].items()):
+                lines.append(f"  injected fault {site}: x{count}")
+            if robustness["drains"]:
+                lines.append(
+                    f"  preemption drains: {robustness['drains']}"
+                )
         continuous = self.continuous_summary()
         if continuous:
             parts = [f"{continuous['chunks']} chunks"]

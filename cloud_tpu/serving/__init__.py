@@ -14,19 +14,25 @@ measured against.  See ``docs/serving.md`` and
 """
 
 from cloud_tpu.serving.engine import (
+    DeadlineExceededError,
+    DispatchTimeoutError,
     EngineClosedError,
     QueueFullError,
     ServeConfig,
     ServeResult,
     ServingEngine,
+    SERVE_DISPATCH_THREAD_NAME,
     SERVE_SCHEDULER_THREAD_NAME,
 )
 
 __all__ = [
+    "DeadlineExceededError",
+    "DispatchTimeoutError",
     "EngineClosedError",
     "QueueFullError",
     "ServeConfig",
     "ServeResult",
     "ServingEngine",
+    "SERVE_DISPATCH_THREAD_NAME",
     "SERVE_SCHEDULER_THREAD_NAME",
 ]

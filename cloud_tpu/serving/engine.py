@@ -80,11 +80,16 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from cloud_tpu.monitoring import metrics, tracing
+from cloud_tpu.utils import faults
 
 logger = logging.getLogger(__name__)
 
 #: Scheduler-thread name (prefix match in tests' thread-leak guards).
 SERVE_SCHEDULER_THREAD_NAME = "cloud-tpu-serve-scheduler"
+
+#: Watchdog-supervised dispatch threads (``dispatch_timeout_s`` set);
+#: same leak-guard prefix family as the scheduler.
+SERVE_DISPATCH_THREAD_NAME = "cloud-tpu-serve-dispatch"
 
 
 class QueueFullError(RuntimeError):
@@ -94,6 +99,18 @@ class QueueFullError(RuntimeError):
 
 class EngineClosedError(RuntimeError):
     """The engine is closed (or closing): the request was not admitted."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's ``deadline_s`` expired while it waited in the queue:
+    it was shed before occupying a decode slot (serving the tokens late
+    would waste capacity the deadline says nobody wants)."""
+
+
+class DispatchTimeoutError(RuntimeError):
+    """A device dispatch exceeded ``dispatch_timeout_s``: the watchdog
+    failed the in-flight requests and marked the engine unhealthy
+    instead of wedging the scheduler forever."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,6 +159,14 @@ class ServeConfig:
     warmup: bool = False
     #: Seed for the engine-owned sampling rng chain (non-greedy configs).
     seed: int = 0
+    #: Watchdog bound on any single device dispatch (prefill, chunk,
+    #: decode).  ``None`` (default) trusts the device; when set, a
+    #: dispatch exceeding it fails its requests with
+    #: :class:`DispatchTimeoutError` and marks the engine unhealthy
+    #: (``health()``) instead of wedging the scheduler forever.  Costs
+    #: one short-lived supervision thread per dispatch — serving rigs
+    #: that want an SLO on "the device answered at all" opt in.
+    dispatch_timeout_s: Optional[float] = None
 
     def __post_init__(self):
         from cloud_tpu.models.generation import SampleConfig
@@ -184,6 +209,11 @@ class ServeConfig:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
         if self.flush_deadline_s < 0:
             raise ValueError("flush_deadline_s must be >= 0")
+        if self.dispatch_timeout_s is not None and self.dispatch_timeout_s <= 0:
+            raise ValueError(
+                f"dispatch_timeout_s must be > 0 or None, "
+                f"got {self.dispatch_timeout_s}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -214,6 +244,12 @@ class _Request:
     bucket_len: int
     future: Future
     submitted: float  # perf_counter
+    #: Absolute perf_counter time after which the request is shed from
+    #: the queue instead of served (None: wait forever).
+    deadline: Optional[float] = None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
 
 
 @dataclasses.dataclass
@@ -318,6 +354,14 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self._cells: Dict[Tuple[int, int], _Cell] = {}
         self._warmup_plan = None
+        #: Why the engine is unhealthy (watchdog fire, scheduler crash);
+        #: None while healthy.  Written by the scheduler, read by
+        #: ``health()`` from any thread (str swap — atomic enough).
+        self._unhealthy_reason: Optional[str] = None
+        #: Watchdog-abandoned dispatch threads, joined (bounded) by
+        #: close() so a finite hang never leaks past the engine's life.
+        self._orphan_dispatches: List[threading.Thread] = []
+        self._last_dispatch_ts: Optional[float] = None
 
         self._stats_lock = threading.Lock()
         self._stats = {
@@ -329,6 +373,8 @@ class ServingEngine:
             "decode_slot_steps": 0, "useful_decode_tokens": 0,
             # Continuous-mode churn counters.
             "inserts": 0, "retires": 0, "expired": 0, "chunks": 0,
+            # Robustness counters: queue-shed deadlines, watchdog fires.
+            "shed": 0, "watchdog_timeouts": 0,
         }
         self._qps = metrics.WindowedRate("serve/qps", window=16)
         self._tokens_rate = metrics.WindowedRate(
@@ -410,6 +456,15 @@ class ServingEngine:
             thread.join(timeout)
         if self._warmup_plan is not None:
             self._warmup_plan.wait(timeout=timeout)
+        # Watchdog-abandoned dispatches: a finite hang (chaos harness,
+        # recovered device) unwinds here so the closed engine owns zero
+        # live threads; a truly wedged one is left daemonized after the
+        # bounded join (nothing in-process can reclaim it).
+        for orphan in self._orphan_dispatches:
+            orphan.join(timeout if timeout is not None else 60.0)
+        self._orphan_dispatches = [
+            t for t in self._orphan_dispatches if t.is_alive()
+        ]
         now = time.perf_counter()
         self._qps.flush(now)
         self._tokens_rate.flush(now)
@@ -427,8 +482,8 @@ class ServingEngine:
     def max_prompt_len(self) -> int:
         return self.serve_config.prompt_buckets[-1]
 
-    def submit(self, prompt, *, max_new_tokens: Optional[int] = None
-               ) -> Future:
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Future:
         """Enqueue one prompt; returns a Future of :class:`ServeResult`.
 
         ``prompt`` is a 1-D int sequence (length 1 ..
@@ -438,8 +493,19 @@ class ServingEngine:
         direct run); above it is an error.  Thread-safe; blocks or
         raises :class:`QueueFullError` at ``max_queue`` per the
         admission policy.
+
+        ``deadline_s`` bounds the QUEUE WAIT: a request still waiting
+        when its deadline passes is shed — its future fails with
+        :class:`DeadlineExceededError` — without ever occupying a decode
+        slot, so under overload capacity goes to requests whose caller
+        is still listening (the load-shedding half of an SLO).  A
+        request that reached the device before the deadline runs to
+        completion; dispatch is never aborted mid-flight for deadlines
+        (that is the watchdog's job, and only for hangs).
         """
         cfg = self.serve_config
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
             raise ValueError(
@@ -458,10 +524,14 @@ class ServingEngine:
                 f"max_new_tokens {m} outside [1, {cfg.max_new_tokens}]"
             )
         bucket_len = next(b for b in cfg.prompt_buckets if b >= n)
+        submitted = time.perf_counter()
         request = _Request(
             prompt=prompt, prompt_len=n, max_new_tokens=m,
             bucket_len=bucket_len, future=Future(),
-            submitted=time.perf_counter(),
+            submitted=submitted,
+            deadline=(
+                None if deadline_s is None else submitted + deadline_s
+            ),
         )
         with self._cond:
             if self._closed:
@@ -632,6 +702,94 @@ class ServingEngine:
             with self._stats_lock:
                 self._stats["failed"] += failed
 
+    def _shed_expired_locked(self, now: float) -> int:
+        """Drop queued requests whose deadline passed (caller holds the
+        lock).  Runs at every scheduling decision, so a request is shed
+        at the first opportunity AFTER expiry — before it can claim a
+        slot or a batch row — with a typed failure the caller can
+        distinguish from a crash.  Returns the shed count."""
+        shed = 0
+        for queue_ in self._pending.values():
+            if not queue_ or not any(r.expired(now) for r in queue_):
+                continue
+            kept = collections.deque()
+            while queue_:
+                request = queue_.popleft()
+                if not request.expired(now):
+                    kept.append(request)
+                    continue
+                self._waiting -= 1
+                shed += 1
+                waited = now - request.submitted
+                tracing.record_span(
+                    "serve/shed", request.submitted, now,
+                    bucket=request.bucket_len, reason="deadline",
+                )
+                try:
+                    request.future.set_exception(DeadlineExceededError(
+                        f"request shed after waiting {waited:.3f}s; "
+                        f"deadline_s="
+                        f"{request.deadline - request.submitted:.3f}"
+                    ))
+                except InvalidStateError:  # pragma: no cover - cancelled
+                    pass
+            queue_.extend(kept)
+        if shed:
+            metrics.counter_inc("serve/deadline_exceeded", shed)
+            with self._stats_lock:
+                self._stats["shed"] += shed
+            self._cond.notify_all()  # admission space freed
+        return shed
+
+    # -- watchdog ----------------------------------------------------------
+
+    def _supervised(self, label: str, fn):
+        """Run one device dispatch under the watchdog (no-op without
+        ``dispatch_timeout_s``).
+
+        The dispatch runs on a short-lived supervised thread; if it
+        does not finish inside the budget the scheduler raises
+        :class:`DispatchTimeoutError` — failing the dispatch's requests
+        and (via the crash path) the engine — rather than blocking
+        forever on a wedged device program.  The abandoned thread is
+        remembered and joined by ``close()``: a finite hang (the chaos
+        harness's ``hang`` mode, a recovered device) unwinds without a
+        leak; a truly wedged program leaves one daemon thread, which is
+        the best Python can do short of killing the process.
+        """
+        timeout = self.serve_config.dispatch_timeout_s
+        self._last_dispatch_ts = time.perf_counter()
+        if timeout is None:
+            return fn()
+        box: dict = {}
+        done = threading.Event()
+
+        def runner():
+            try:
+                box["result"] = fn()
+            except BaseException as exc:  # noqa: BLE001 — rethrown below
+                box["error"] = exc
+            finally:
+                done.set()
+
+        thread = threading.Thread(
+            target=runner, daemon=True, name=SERVE_DISPATCH_THREAD_NAME
+        )
+        thread.start()
+        if not done.wait(timeout):
+            self._orphan_dispatches.append(thread)
+            self._unhealthy_reason = (
+                f"{label} exceeded dispatch_timeout_s={timeout}"
+            )
+            metrics.counter_inc("serve/watchdog_timeouts")
+            with self._stats_lock:
+                self._stats["watchdog_timeouts"] += 1
+            raise DispatchTimeoutError(self._unhealthy_reason)
+        thread.join()
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
     def _pop_batch_locked(self, now: float) -> Optional[List[_Request]]:
         """The batch-formation policy (caller holds the lock).
 
@@ -645,6 +803,7 @@ class ServingEngine:
         (3) when draining a closed engine, anything left.  Whichever
         bucket wins, up to a full max-batch is taken from it.
         """
+        self._shed_expired_locked(now)
         max_batch = self.serve_config.batch_buckets[-1]
         chosen = None
         for queue_ in self._pending.values():
@@ -671,10 +830,21 @@ class ServingEngine:
         return batch
 
     def _earliest_deadline_locked(self) -> Optional[float]:
-        heads = [q[0].submitted for q in self._pending.values() if q]
-        if not heads:
-            return None
-        return min(heads) + self.serve_config.flush_deadline_s
+        """Next instant the batch scheduler must wake: the earliest
+        flush deadline OR the earliest request ``deadline_s`` expiry —
+        a lone request must be shed when ITS deadline passes, not when
+        the (possibly much later) flush deadline happens to wake the
+        loop."""
+        flush = self.serve_config.flush_deadline_s
+        deadlines = []
+        for queue_ in self._pending.values():
+            if not queue_:
+                continue
+            deadlines.append(queue_[0].submitted + flush)
+            deadlines.extend(
+                r.deadline for r in queue_ if r.deadline is not None
+            )
+        return min(deadlines) if deadlines else None
 
     def _scheduler_loop(self) -> None:
         try:
@@ -686,6 +856,8 @@ class ServingEngine:
             # die silently: fail everything still queued and in flight,
             # and refuse new work.
             logger.exception("serving scheduler crashed")
+            if self._unhealthy_reason is None:
+                self._unhealthy_reason = f"scheduler crashed: {exc!r}"
             with self._cond:
                 self._closed = True
                 self._fail_pending_locked(exc)
@@ -723,6 +895,12 @@ class ServingEngine:
                         request.future.set_exception(exc)
                     except InvalidStateError:  # pragma: no cover
                         pass
+                if isinstance(exc, DispatchTimeoutError):
+                    # A wedged device program is not a per-batch blip:
+                    # the next dispatch would hang the same way.  Take
+                    # the engine down (crash handler fails the queue and
+                    # leaves health() unhealthy).
+                    raise
 
     # -- continuous scheduler ----------------------------------------------
 
@@ -778,6 +956,7 @@ class ServingEngine:
         """Claim one free slot per waiting request, oldest submit first
         across every bucket (FIFO — a minority bucket cannot starve).
         Caller holds the lock; dispatch happens outside it."""
+        self._shed_expired_locked(time.perf_counter())
         popped = False
         while self._free_slots:
             oldest = None
@@ -810,12 +989,19 @@ class ServingEngine:
         tokens[0, :request.prompt_len] = request.prompt
         cell = self._insert_cell(request.bucket_len)
         self._rng, insert_rng = jax.random.split(self._rng)
-        with tracing.span("serve/prefill", bucket=request.bucket_len,
-                          slot=slot):
-            self._grid_cache, self._slot_state, tok0 = cell(
+
+        def dispatch():
+            faults.fault_point("serve.prefill")
+            return cell(
                 self.params, self._grid_cache, self._slot_state, tokens,
                 np.int32(request.prompt_len), np.int32(slot),
                 np.int32(request.max_new_tokens), insert_rng,
+            )
+
+        with tracing.span("serve/prefill", bucket=request.bucket_len,
+                          slot=slot):
+            self._grid_cache, self._slot_state, tok0 = self._supervised(
+                "serve/prefill", dispatch
             )
             tok0 = int(np.asarray(tok0))
         self._slot_table[slot] = _Slot(request=request, tokens=[tok0])
@@ -837,15 +1023,19 @@ class ServingEngine:
         cfg = self.serve_config
         num_slots, chunk = cfg.num_slots, cfg.chunk_tokens
         self._rng, chunk_rng = jax.random.split(self._rng)
+
+        def dispatch():
+            faults.fault_point("serve.chunk")
+            return self._chunk_step(
+                self.params, self._grid_cache, self._slot_state, chunk_rng,
+            )
+
         with tracing.span(
             "serve/chunk", slots=num_slots, chunk=chunk,
             active=len(self._active_slots),
         ) as chunk_span:
             self._grid_cache, self._slot_state, toks, valid = (
-                self._chunk_step(
-                    self.params, self._grid_cache, self._slot_state,
-                    chunk_rng,
-                )
+                self._supervised("serve/chunk", dispatch)
             )
             toks = np.asarray(toks)
             valid = np.asarray(valid)
@@ -955,15 +1145,25 @@ class ServingEngine:
                 lens[i] = request.prompt_len
         cell = self._cell(bucket_len, batch_size)
         self._rng, batch_rng = jax.random.split(self._rng)
-        with tracing.span("serve/prefill", bucket=bucket_len,
-                          batch=batch_size):
+
+        def prefill():
+            faults.fault_point("serve.prefill")
             cache, logits0 = cell.prefill(self.params, tokens, lens)
             jax.block_until_ready(logits0)
+            return cache, logits0
+
+        with tracing.span("serve/prefill", bucket=bucket_len,
+                          batch=batch_size):
+            cache, logits0 = self._supervised("serve/prefill", prefill)
+
+        def decode():
+            faults.fault_point("serve.decode")
+            out = cell.decode(self.params, cache, logits0, lens, batch_rng)
+            return np.asarray(out["tokens"]), np.asarray(out["num_generated"])
+
         with tracing.span("serve/decode", bucket=bucket_len,
                           batch=batch_size):
-            out = cell.decode(self.params, cache, logits0, lens, batch_rng)
-            out_tokens = np.asarray(out["tokens"])
-            out_nums = np.asarray(out["num_generated"])
+            out_tokens, out_nums = self._supervised("serve/decode", decode)
         done = time.perf_counter()
 
         results = []
@@ -1012,6 +1212,43 @@ class ServingEngine:
                 pass
 
     # -- introspection -----------------------------------------------------
+
+    def health(self) -> dict:
+        """Readiness/liveness snapshot (the shape a /healthz endpoint or
+        an external supervisor polls; cheap, lock-bounded, any thread).
+
+        ``healthy`` — no watchdog fire, no scheduler crash (a cleanly
+        closed engine is still healthy: it stopped, it didn't break).
+        ``ready`` — accepting new ``submit()`` calls right now.
+        ``live`` — the scheduler thread exists and is running.
+        ``reason`` — why ``healthy`` is False, else None.  Plus queue
+        depth, live/free slot counts (continuous mode), orphaned
+        dispatch count, and seconds since the last device dispatch
+        (None before the first) for staleness alerting.
+        """
+        with self._cond:
+            waiting = self._waiting
+            closed = self._closed
+            thread = self._thread
+        live = thread is not None and thread.is_alive()
+        reason = self._unhealthy_reason
+        last = self._last_dispatch_ts
+        snap = {
+            "healthy": reason is None,
+            "ready": live and not closed and reason is None,
+            "live": live,
+            "reason": reason,
+            "closed": closed,
+            "waiting": waiting,
+            "orphaned_dispatches": len(self._orphan_dispatches),
+            "last_dispatch_age_s": (
+                None if last is None else time.perf_counter() - last
+            ),
+        }
+        if self._continuous:
+            snap["active_slots"] = len(self._active_slots)
+            snap["free_slots"] = len(self._free_slots)
+        return snap
 
     def stats(self) -> dict:
         """Counters snapshot plus the two occupancy quotients.

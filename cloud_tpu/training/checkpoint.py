@@ -13,7 +13,8 @@ import logging
 import os
 from typing import Any, Optional
 
-from cloud_tpu.monitoring import tracing
+from cloud_tpu.monitoring import metrics, tracing
+from cloud_tpu.utils import faults
 
 logger = logging.getLogger(__name__)
 
@@ -49,6 +50,9 @@ class CheckpointManager:
         # Async checkpointing: the span covers the blocking half (host
         # gather + handoff), which is exactly the cost training pays.
         with tracing.span("checkpoint/save", step=int(step)):
+            # Chaos seam: a crashed/hung save surfaces here — the same
+            # place a full disk or a GCS outage would.
+            faults.fault_point("checkpoint.save")
             return self._manager.save(step, args=ocp.args.StandardSave(state))
 
     def restore(self, step: Optional[int] = None, *, template: Any = None):
@@ -58,6 +62,7 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(f"No checkpoints in {self._directory}")
         with tracing.span("checkpoint/restore", step=int(step)):
+            faults.fault_point("checkpoint.restore")
             if template is not None:
                 return self._manager.restore(
                     step, args=ocp.args.StandardRestore(template)
@@ -171,6 +176,17 @@ class CheckpointCallback:
         state["_manager"] = None
         return state
 
+    def _reset_manager_after_failure(self) -> None:
+        """Orbax managers can wedge after a failed async save: count the
+        failure, close best-effort, rebuild lazily on the next use."""
+        metrics.counter_inc("checkpoint/save_failures")
+        manager, self._manager = self._manager, None
+        try:
+            if manager is not None:
+                manager.close()
+        except Exception:  # noqa: BLE001 — already failing
+            logger.debug("failed manager close", exc_info=True)
+
     def on_train_begin(self, trainer):
         if not self.resume or trainer.state is None:
             return
@@ -180,13 +196,38 @@ class CheckpointCallback:
 
     def on_step_end(self, step, logs, trainer):
         if step % self.every_n_steps == 0:
-            self._get().save(step, trainer.state)
+            try:
+                self._get().save(step, trainer.state)
+            except Exception:  # noqa: BLE001 — a periodic save is
+                # redundancy, not the product: a transient failure
+                # (full disk blip, GCS 503, injected chaos) must not
+                # kill a healthy training job.  The next interval — and
+                # the mandatory train-end save — retry with a fresh
+                # manager; only those remaining failures are fatal.
+                logger.exception(
+                    "periodic checkpoint save at step %d failed; training "
+                    "continues (next save at step %d)",
+                    step, step + self.every_n_steps,
+                )
+                self._reset_manager_after_failure()
 
     def on_epoch_end(self, epoch, logs, trainer): ...
 
     def on_train_end(self, trainer):
-        manager = self._get()
-        manager.save(int(trainer.state.step), trainer.state)
+        # The train-end save is the preemption drain's one shot at not
+        # losing work: a single transient failure gets one retry with a
+        # fresh manager before it is allowed to take the job down.
+        try:
+            manager = self._get()
+            manager.save(int(trainer.state.step), trainer.state)
+        except Exception:  # noqa: BLE001 — retried once, then strict
+            logger.exception(
+                "train-end checkpoint save failed; retrying once with a "
+                "fresh manager"
+            )
+            self._reset_manager_after_failure()
+            manager = self._get()
+            manager.save(int(trainer.state.step), trainer.state)
         manager.wait()
         manager.close()
         self._manager = None

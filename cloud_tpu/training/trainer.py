@@ -20,8 +20,9 @@ import numpy as np
 
 from cloud_tpu.monitoring import tracing
 from cloud_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules
-from cloud_tpu.training import compile_cache, pipeline_io
+from cloud_tpu.training import compile_cache, pipeline_io, preemption
 from cloud_tpu.training import train as train_lib
+from cloud_tpu.utils import faults
 
 logger = logging.getLogger(__name__)
 
@@ -321,6 +322,10 @@ class Trainer:
         self.accum_steps = accum_steps
         self.state: Optional[train_lib.TrainState] = None
         self.stop_training = False
+        #: True when the last fit() ended by preemption drain (the
+        #: process-wide stop event, ``training.preemption``) rather than
+        #: data exhaustion or a callback stop.
+        self.drained = False
         self._train_step = train_lib.make_train_step(
             loss_fn, optimizer, logical_axes=logical_axes, rules=rules,
             mesh=mesh, stochastic=stochastic, accum_steps=accum_steps,
@@ -330,6 +335,36 @@ class Trainer:
         # per shape, so reusing the same callable across epochs/fits is
         # what keeps the multi-step path one-compile).
         self._multi_steps: Dict[int, Any] = {}
+
+    def _drain_if_requested(self, step: int) -> bool:
+        """Preemption-drain check, called at every dispatch boundary.
+
+        When the process-wide stop event (``training.preemption`` — set
+        by bootstrap's SIGTERM handler) is up, flip ``stop_training`` so
+        the epoch loop exits cleanly and ``on_train_end`` fires —
+        that's where ``CheckpointCallback`` saves the CURRENT step and
+        waits the async write out, bounding lost work to one dispatch
+        window.  Recorded once per fit as a ``preempt/drain`` span +
+        counter so the robustness report shows the drain happened.
+        """
+        if not preemption.stop_requested():
+            return False
+        if not self.drained:
+            self.drained = True
+            from cloud_tpu.monitoring import metrics as metrics_lib
+
+            metrics_lib.counter_inc("preempt/drains")
+            now = time.perf_counter()
+            tracing.record_span(
+                "preempt/drain", now, now, step=step,
+                reason=preemption.stop_reason() or "",
+            )
+            logger.warning(
+                "preemption drain at step %d (%s): stopping to checkpoint",
+                step, preemption.stop_reason(),
+            )
+        self.stop_training = True
+        return True
 
     def _multi_step_for(self, steps_per_dispatch: int):
         fn = self._multi_steps.get(steps_per_dispatch)
@@ -492,6 +527,7 @@ class Trainer:
 
         for cb in callbacks:
             cb.on_train_begin(self)
+        self.drained = False
         step = int(self.state.step)
         # The first DISPATCH of this fit() is where jit compilation happens
         # (host-side, synchronous): span it separately so compile cost is
@@ -520,7 +556,11 @@ class Trainer:
                     i = 0
                     while steps_per_epoch is None or i < steps_per_epoch:
                         with tracing.span("step/data"):
-                            batch = next(data_iter, None)
+                            # Chaos seam: an injected plan can fail/hang
+                            # or corrupt the iterator pull here.
+                            batch = faults.fault_point(
+                                "data.next", next(data_iter, None)
+                            )
                         if batch is None:
                             break
                         if first_dispatch and aot_plan is not None:
@@ -534,6 +574,7 @@ class Trainer:
                             else "step/compute"
                         )
                         with tracing.span(compute_span):
+                            faults.fault_point("train.dispatch")
                             batch = train_lib.shard_batch(
                                 batch, self.mesh, self.rules
                             )
@@ -555,12 +596,15 @@ class Trainer:
                         with tracing.span("step/callbacks"):
                             for cb in callbacks:
                                 cb.on_step_end(step, metrics, self)
+                        self._drain_if_requested(step)
                         if self.stop_training:
                             break
                 else:
                     while True:
                         with tracing.span("step/data"):
-                            item = next(data_iter, None)
+                            item = faults.fault_point(
+                                "data.next", next(data_iter, None)
+                            )
                         if item is None:
                             break
                         # Every window — tail included — dispatches the ONE
@@ -600,6 +644,7 @@ class Trainer:
                                 else "step/fused_compute"
                             )
                             with tracing.span(compute_span, steps=n):
+                                faults.fault_point("train.dispatch")
                                 with self._mesh_context():
                                     self.state, metrics = multi_step(
                                         self.state, payload, valid
@@ -613,6 +658,7 @@ class Trainer:
                         with tracing.span("step/callbacks"):
                             for cb in callbacks:
                                 cb.on_step_end(step, metrics, self)
+                        self._drain_if_requested(step)
                         if self.stop_training:
                             break
             finally:
@@ -628,7 +674,9 @@ class Trainer:
                 for k_, v in epoch_host.items()
             }
             logs["epoch_seconds"] = time.perf_counter() - epoch_start
-            if validation_data is not None:
+            # A drain is racing a preemption grace window: skip the
+            # epoch's validation pass and get to the checkpoint save.
+            if validation_data is not None and not self.drained:
                 val = self.evaluate(
                     validation_data, prefetch=prefetch, step_fn=eval_step
                 )

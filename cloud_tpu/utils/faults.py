@@ -1,0 +1,279 @@
+"""Deterministic fault injection: the chaos harness behind every
+degradation path this framework claims to survive.
+
+Fault tolerance that is only exercised by real outages is folklore; the
+lineage this repo reproduces treats partial failure as a first-class
+design axis (TensorFlow, arXiv:1605.08695 §4.3) and the serving
+comparisons it targets measure tail behavior *under* faults.  So the
+seams where reality bites — an API request, a checkpoint save, a data
+iterator pull, a device dispatch — each carry a named
+:func:`fault_point`, and a test (or an operator on a staging rig)
+activates a :class:`FaultPlan` against those names:
+
+    plan = [{"site": "api.request", "mode": "raise",
+             "error": "transient", "times": 2}]
+    with faults.inject(plan):
+        deploy.deploy_job(...)   # first two API calls fail with 503-class
+                                 # errors; the retry layer must absorb them
+
+Triggers are deterministic — ``nth`` (fire on exactly the nth call of
+that site, 1-based), ``every`` (fire on every k-th call), ``times`` (stop
+after n firings; default 1 for ``nth``, unbounded for ``every``) — so a
+chaos run is reproducible, assertable, and diffable against the
+fault-free run.  Modes:
+
+``raise``
+    Raise a typed error at the seam.  ``error`` selects the class:
+    ``"transient"`` (an :class:`~cloud_tpu.utils.api_client.ApiTransientError`
+    with status 503 — the retryable class), ``"api"`` (a permanent
+    :class:`~cloud_tpu.utils.api_client.ApiError` 400), anything else (or
+    omitted) a plain :class:`FaultInjected` RuntimeError.
+``hang``
+    Sleep ``hang_s`` seconds at the seam (default 30) — a finite stand-in
+    for a wedged dispatch, long enough to trip any reasonable watchdog,
+    short enough that harness threads eventually unwind and leak checks
+    stay meaningful.
+``corrupt``
+    Make ``fault_point(site, result=x)`` return ``value`` from the rule
+    (default ``None``) instead of ``x`` — a poisoned read (truncated
+    checkpoint metadata, garbage payload) rather than a loud failure.
+
+Cross-process propagation: :func:`inject` also exports the plan as
+``CLOUD_TPU_FAULT_PLAN`` (JSON) so bootstrap-spawned children and the
+cloud_fit server inject the very same plan; ``core.bootstrap`` calls
+:func:`maybe_install_from_env` before user code runs.  Call counters are
+per-process, so a child's "2nd api.request" is counted in the child.
+
+Disabled — the production state — costs one module-global ``is None``
+check per seam, no locks, no allocation.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+#: JSON fault plan, exported by :func:`inject` and read at bootstrap so a
+#: deployed container (or a spawned child harness) injects the same plan.
+ENV_FAULT_PLAN = "CLOUD_TPU_FAULT_PLAN"
+
+_VALID_MODES = ("raise", "hang", "corrupt")
+
+
+class FaultInjected(RuntimeError):
+    """The default injected failure (mode="raise" with no error class)."""
+
+
+class _Rule:
+    """One compiled plan entry; owns its own firing bookkeeping."""
+
+    __slots__ = ("site", "mode", "nth", "every", "times", "hang_s",
+                 "error", "value", "fired")
+
+    def __init__(self, spec: Dict[str, Any]):
+        unknown = set(spec) - {
+            "site", "mode", "nth", "every", "times", "hang_s", "error",
+            "value",
+        }
+        if unknown:
+            raise ValueError(f"unknown fault-rule keys {sorted(unknown)}")
+        self.site = spec.get("site")
+        if not self.site or not isinstance(self.site, str):
+            raise ValueError(f"fault rule needs a string 'site': {spec}")
+        self.mode = spec.get("mode", "raise")
+        if self.mode not in _VALID_MODES:
+            raise ValueError(
+                f"fault mode must be one of {_VALID_MODES}, "
+                f"got {self.mode!r}"
+            )
+        self.nth = spec.get("nth")
+        self.every = spec.get("every")
+        if self.nth is not None and self.every is not None:
+            raise ValueError("fault rule takes 'nth' OR 'every', not both")
+        for name in ("nth", "every"):
+            v = getattr(self, name)
+            if v is not None and (not isinstance(v, int) or v < 1):
+                raise ValueError(f"'{name}' must be a positive int, got {v!r}")
+        # Default trigger: every call (nth=None, every=1) bounded by times.
+        default_times = 1 if self.nth is not None else None
+        self.times = spec.get("times", default_times)
+        if self.times is not None and self.times < 1:
+            raise ValueError(f"'times' must be >= 1, got {self.times}")
+        self.hang_s = float(spec.get("hang_s", 30.0))
+        self.error = spec.get("error")
+        self.value = spec.get("value")
+        self.fired = 0
+
+    def should_fire(self, call_number: int) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.nth is not None:
+            return call_number == self.nth
+        every = self.every or 1
+        return call_number % every == 0
+
+
+class FaultPlan:
+    """A compiled plan: site -> rules, plus per-site call counters."""
+
+    def __init__(self, rules: Sequence[Dict[str, Any]]):
+        self._lock = threading.Lock()
+        self._rules: List[_Rule] = [_Rule(dict(r)) for r in rules]
+        self._calls: Dict[str, int] = {}
+        self.spec = [dict(r) for r in rules]
+
+    def match(self, site: str) -> Optional[_Rule]:
+        """Count one call at ``site``; return the rule to fire, if any."""
+        with self._lock:
+            n = self._calls.get(site, 0) + 1
+            self._calls[site] = n
+            for rule in self._rules:
+                if rule.site == site and rule.should_fire(n):
+                    rule.fired += 1
+                    return rule
+        return None
+
+    def fired(self) -> Dict[str, int]:
+        """Total firings per site (post-mortem assertion surface)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for rule in self._rules:
+                out[rule.site] = out.get(rule.site, 0) + rule.fired
+            return out
+
+    def calls(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._calls)
+
+
+_active: Optional[FaultPlan] = None
+_lock = threading.Lock()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _active
+
+
+def fault_point(site: str, result: Any = None,
+                sleep=time.sleep) -> Any:
+    """A named seam: returns ``result`` untouched unless an active plan
+    fires here.  One ``is None`` check when no plan is installed, so the
+    hooks are safe to leave in hot paths permanently.
+
+    ``sleep`` is injectable so unit tests of hang rules stay instant.
+    """
+    plan = _active
+    if plan is None:
+        return result
+    rule = plan.match(site)
+    if rule is None:
+        return result
+    from cloud_tpu.monitoring import metrics, tracing
+
+    metrics.counter_inc("faults/injected")
+    metrics.counter_inc(f"faults/injected/{site}")
+    start = time.perf_counter()
+    if rule.mode == "hang":
+        logger.warning("fault injected at %s: hang %.1fs", site, rule.hang_s)
+        sleep(rule.hang_s)
+        tracing.record_span(f"fault/{site}", start, time.perf_counter(),
+                            mode="hang")
+        return result
+    tracing.record_span(f"fault/{site}", start, start, mode=rule.mode)
+    if rule.mode == "corrupt":
+        logger.warning("fault injected at %s: corrupt result", site)
+        return rule.value
+    logger.warning("fault injected at %s: raise %s", site,
+                   rule.error or "FaultInjected")
+    raise _make_error(site, rule)
+
+
+def _make_error(site: str, rule: _Rule) -> BaseException:
+    if rule.error == "transient":
+        from cloud_tpu.utils import api_client
+
+        return api_client.ApiTransientError(
+            503, f"injected transient fault at {site}"
+        )
+    if rule.error == "api":
+        from cloud_tpu.utils import api_client
+
+        return api_client.ApiError(400, f"injected permanent fault at {site}")
+    return FaultInjected(f"injected fault at {site}")
+
+
+class inject:
+    """Install a fault plan for a block (and export it to children).
+
+    ``plan`` is a list of rule dicts (module docstring), a
+    :class:`FaultPlan`, or a JSON string of the list form.  Nesting is
+    rejected — two overlapping chaos plans have no defined semantics.
+    The plan object is yielded so the block can assert ``plan.fired()``.
+    """
+
+    def __init__(self, plan, *, propagate: bool = True):
+        if isinstance(plan, str):
+            plan = json.loads(plan)
+        self.plan = plan if isinstance(plan, FaultPlan) else FaultPlan(plan)
+        self.propagate = propagate
+        # Serialize BEFORE any global state is touched: a plan that can't
+        # round-trip (a non-JSON 'value') must fail here, not leave the
+        # plan installed forever with no __exit__ to remove it.
+        self._env_value = json.dumps(self.plan.spec)
+        self._env_before: Optional[str] = None
+
+    def __enter__(self) -> FaultPlan:
+        global _active
+        with _lock:
+            if _active is not None:
+                raise RuntimeError("a fault plan is already active")
+            _active = self.plan
+        if self.propagate:
+            self._env_before = os.environ.get(ENV_FAULT_PLAN)
+            os.environ[ENV_FAULT_PLAN] = self._env_value
+        return self.plan
+
+    def __exit__(self, exc_type, exc, tb):
+        global _active
+        with _lock:
+            _active = None
+        if self.propagate:
+            if self._env_before is None:
+                os.environ.pop(ENV_FAULT_PLAN, None)
+            else:
+                os.environ[ENV_FAULT_PLAN] = self._env_before
+        return False
+
+
+def maybe_install_from_env() -> bool:
+    """Install the plan from ``CLOUD_TPU_FAULT_PLAN`` (bootstrap calls
+    this before user code so spawned children chaos-test the same way
+    the parent asked for).  Idempotent; a malformed plan logs and is
+    ignored — a broken chaos knob must never take production down.
+    """
+    global _active
+    raw = os.environ.get(ENV_FAULT_PLAN)
+    if not raw:
+        return False
+    with _lock:
+        if _active is not None:
+            return True
+        try:
+            _active = FaultPlan(json.loads(raw))
+        except (ValueError, TypeError):
+            logger.exception("ignoring malformed %s", ENV_FAULT_PLAN)
+            return False
+    logger.warning("fault plan installed from env: %s", raw)
+    return True
+
+
+def _clear_for_tests() -> None:
+    global _active
+    with _lock:
+        _active = None
