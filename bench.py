@@ -91,6 +91,14 @@ SERVE_MAX_BATCH = 8
 SERVE_CHURN_REQUESTS = 24
 SERVE_CHURN_CHUNK = 8
 
+#: Fleet probe (cloud_tpu.fleet): the same churn workload through TWO
+#: engine replicas behind the health-aware router, so what the fleet
+#: layer adds (routing overhead) or buys (parallel replicas) is a
+#: per-round number next to the single-engine churn metrics.  On the
+#: CPU rig these are two CPU replicas; on a single-chip TPU endpoint the
+#: replicas share the chip (the router still spreads queueing).
+FLEET_REPLICAS = 2
+
 METRIC = f"resnet50_cifar10_b{BATCH_SIZE}_train_steps_per_sec_per_chip"
 
 #: The last DRIVER-VERIFIED number (BENCH_r02.json, 2026-07-29, TPU v5e-1,
@@ -694,6 +702,77 @@ def _measure_serving_churn(extras):
     )
 
 
+def _measure_fleet(extras):
+    """Fleet probe: the churn workload (staggered arrivals, mixed prompt
+    AND output lengths) through ``cloud_tpu.fleet.Fleet`` fronting
+    ``FLEET_REPLICAS`` serving engines.  Emits fleet tokens/sec and
+    latency percentiles — measured at the FLEET submit surface, so they
+    include routing — plus the failover count (0 in a healthy run; the
+    chaos coverage lives in scripts/check_fleet.py).
+    """
+    from cloud_tpu.fleet import Fleet, FleetConfig
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils.benchmarking import decode_setup
+
+    import numpy as np
+
+    cfg, params, _, _ = decode_setup(
+        batch_size=SERVE_MAX_BATCH, prompt_len=SERVE_PROMPT_BUCKET
+    )
+    serve = ServeConfig(
+        max_new_tokens=SERVE_NEW_TOKENS,
+        prompt_buckets=(SERVE_PROMPT_BUCKET // 2, SERVE_PROMPT_BUCKET),
+        batch_buckets=(1, SERVE_MAX_BATCH),
+        num_slots=SERVE_MAX_BATCH,
+        chunk_tokens=SERVE_CHURN_CHUNK,
+        warmup=True,
+        admission="reject",  # fleet backstop: full replicas fail over
+    )
+
+    def factory():
+        return ServingEngine(params, cfg, serve, mesh=None)
+
+    rng = np.random.default_rng(2)
+    lengths = rng.integers(
+        8, SERVE_PROMPT_BUCKET + 1, SERVE_CHURN_REQUESTS
+    )
+    budgets = rng.integers(
+        SERVE_NEW_TOKENS // 4, SERVE_NEW_TOKENS + 1, SERVE_CHURN_REQUESTS
+    )
+    prompts = [
+        rng.integers(1, cfg.vocab_size, n).astype(np.int32) for n in lengths
+    ]
+    fleet_config = FleetConfig(
+        min_replicas=FLEET_REPLICAS, max_replicas=FLEET_REPLICAS,
+        poll_interval_s=0.1,
+    )
+    with Fleet(factory, fleet_config) as fleet:
+        fleet.wait_ready()
+        fleet.submit(prompts[0]).result()  # absorb residual first-dispatch
+        start = time.perf_counter()
+        futures = []
+        for i, prompt in enumerate(prompts):
+            futures.append(
+                fleet.submit(prompt, max_new_tokens=int(budgets[i]))
+            )
+            if (i + 1) % (SERVE_MAX_BATCH // 2) == 0:
+                time.sleep(0.02)  # staggered waves, not one burst
+        results = [f.result() for f in futures]
+        wall = time.perf_counter() - start
+        stats = fleet.stats()
+    latencies = sorted(r.latency_seconds for r in results)
+    total_tokens = sum(r.num_generated for r in results)
+    extras["fleet_tokens_per_sec"] = round(total_tokens / wall, 1)
+    extras["fleet_p50_latency_seconds"] = round(_latency_pct(latencies, 0.5), 4)
+    extras["fleet_p99_latency_seconds"] = round(_latency_pct(latencies, 0.99), 4)
+    extras["fleet_failover_count"] = stats["failovers"]
+    extras["fleet_config"] = (
+        f"SMALL replicas{FLEET_REPLICAS} slots{SERVE_MAX_BATCH} "
+        f"chunk{SERVE_CHURN_CHUNK} new<= {SERVE_NEW_TOKENS} "
+        f"n{SERVE_CHURN_REQUESTS} staggered"
+    )
+
+
 def _child_main() -> int:
     """Headline first; every phase prints its own salvageable JSON line."""
     # Span tracing on for the whole child: compile vs measure wall-clock
@@ -757,6 +836,7 @@ def _child_main() -> int:
         (_measure_decode, "decode"),
         (_measure_serving, "serving"),
         (_measure_serving_churn, "serving_churn"),
+        (_measure_fleet, "fleet"),
     ):
         phase_extras = {"peak_bf16_tflops": extras.get("peak_bf16_tflops")}
         try:

@@ -226,6 +226,63 @@ def build_job_request(
     return {"job_id": job_id, "nodes": nodes}
 
 
+def build_serve_fleet_request(
+    image_uri: str,
+    replica_config: machine_config.MachineConfig,
+    num_replicas: int,
+    plan: planner.MeshPlan,
+    *,
+    job_id: Optional[str] = None,
+    job_labels: Optional[Dict[str, str]] = None,
+    service_account: Optional[str] = None,
+    monitoring: bool = True,
+    profiler_port: Optional[int] = None,
+    submit_ts: Optional[float] = None,
+    compile_cache: Optional[str] = None,
+) -> dict:
+    """Node bodies for a serve FLEET: N independent single-slice replicas.
+
+    The topology deliberately inverts :func:`build_job_request`'s.  A
+    training job is ONE jax_graft process group — every slice dials the
+    same coordinator, so losing any slice stalls the whole job.  A serve
+    fleet is N *separate* process groups: replica i's coordinator is its
+    own host 0 (``<node>-w0``), process ids restart at 0 per replica, so
+    replicas boot, fail, restart, and scale independently — exactly the
+    unit ``cloud_tpu.fleet.Fleet`` routes over and its supervisor
+    recreates.  Node ids are ``<job_id>-r<i>`` and every node carries
+    ``cloud_tpu_role: serve-replica`` plus its ``cloud_tpu_replica``
+    index, so a fronting router (or ``supervise_job``-style tooling) can
+    enumerate the fleet by label.  The same request shape deploys through
+    :func:`deploy_job` (each replica is just a node create).
+    """
+    if num_replicas < 1:
+        raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+    job_id = job_id or _job_id()
+    hosts = plan.hosts_per_slice
+    nodes = {}
+    for i in range(num_replicas):
+        node_id = f"{job_id}-r{i}"
+        nodes[node_id] = build_node_request(
+            image_uri,
+            replica_config,
+            coordinator_address=f"{node_id}-w0:8476",
+            num_processes=hosts,
+            process_id_base=0,
+            job_labels={
+                **(job_labels or {}),
+                "cloud_tpu_job": job_id,
+                "cloud_tpu_role": "serve-replica",
+                "cloud_tpu_replica": str(i),
+            },
+            service_account=service_account,
+            monitoring=monitoring,
+            profiler_port=profiler_port,
+            submit_ts=submit_ts,
+            compile_cache=compile_cache,
+        )
+    return {"job_id": job_id, "nodes": nodes, "role": "serve-fleet"}
+
+
 def deploy_job(
     image_uri: str,
     chief_config: machine_config.MachineConfig,

@@ -1,0 +1,44 @@
+"""Horizontally scalable serving: a health-aware router, replica
+supervision, and queue-depth autoscaling over N serving engines.
+
+``cloud_tpu.serving`` proved the single-engine unit (continuous
+batching, deadlines, watchdog, typed errors); this package is the thin
+replication layer over it — one :class:`Fleet` fronts many engine
+replicas behind a single ``submit()``, routes each request to the
+least-loaded healthy replica, fails over around dead or saturated ones
+(bounded by a :class:`~cloud_tpu.utils.retries.RetryPolicy`), restarts
+unhealthy engines without dropping admitted requests, and scales the
+replica count with queue depth — scale-down only via graceful drain.
+See ``docs/fleet.md`` and :mod:`cloud_tpu.fleet.fleet`.
+"""
+
+from cloud_tpu.fleet.autoscaler import AutoscaleConfig, QueueDepthAutoscaler
+from cloud_tpu.fleet.fleet import (
+    FLEET_DRAIN_THREAD_NAME,
+    FLEET_ROUTER_THREAD_NAME,
+    FLEET_SUPERVISOR_THREAD_NAME,
+    Fleet,
+    FleetClosedError,
+    FleetConfig,
+    NoReplicaAvailableError,
+    default_route_policy,
+    route_transient,
+)
+from cloud_tpu.fleet.replica import Replica
+from cloud_tpu.fleet.router import LeastLoadedRouter
+
+__all__ = [
+    "AutoscaleConfig",
+    "Fleet",
+    "FleetClosedError",
+    "FleetConfig",
+    "FLEET_DRAIN_THREAD_NAME",
+    "FLEET_ROUTER_THREAD_NAME",
+    "FLEET_SUPERVISOR_THREAD_NAME",
+    "LeastLoadedRouter",
+    "NoReplicaAvailableError",
+    "QueueDepthAutoscaler",
+    "Replica",
+    "default_route_policy",
+    "route_transient",
+]

@@ -1,0 +1,890 @@
+"""Health-aware serving fleet: one submit surface over N engine replicas.
+
+Everything below the fleet tops out at ONE
+:class:`~cloud_tpu.serving.ServingEngine` — a single scheduler thread
+driving a single decode grid.  Serving heavy traffic needs the thin
+layer TF-Replicator argues for over single-device programs (arxiv
+1902.00465): replicate the proven unit, then route around its failures.
+:class:`Fleet` is that layer, three cooperating pieces over the PR 6
+typed-error seams:
+
+* **Router** — every :meth:`Fleet.submit` lands in one fleet-level
+  queue; a dispatcher thread routes each request to the least-loaded
+  ready replica (``queue_depth + active_slots`` from ``health()`` —
+  :mod:`cloud_tpu.fleet.router`).  A replica that raises
+  :class:`~cloud_tpu.serving.QueueFullError` or went unready fails over
+  to the next candidate, bounded by a
+  :class:`~cloud_tpu.utils.retries.RetryPolicy` (attempts + backoff);
+  per-request ``deadline_s`` is preserved across hops — the remaining
+  budget, not the original, reaches the replica — and a request whose
+  deadline expires while queued at the fleet is shed with
+  :class:`~cloud_tpu.serving.DeadlineExceededError` *before* any
+  replica submit.  Failover never re-submits an expired request.
+* **Replica supervisor** — a poll loop watches every replica's
+  ``health()``; an engine that went unhealthy (watchdog fire, dead or
+  crashed scheduler) is killed and rebuilt through the engine factory
+  (``fleet.replica_start`` fault seam).  Its admitted requests fail
+  with the engine's typed errors, which the fleet's completion
+  callbacks convert into re-entry at the *front* of the fleet queue —
+  supervision drops nothing, and greedy outputs stay token-identical
+  because a re-run request replays the same deterministic decode.
+* **Autoscaler** — windowed fleet queue depth and mean slot occupancy
+  feed :class:`~cloud_tpu.fleet.autoscaler.QueueDepthAutoscaler`;
+  sustained backlog adds replicas up to ``max_replicas``, sustained
+  idleness drains them back to ``min_replicas`` — scale-down only ever
+  via graceful drain (the retiring replica serves everything it
+  admitted).
+
+Observability rides the PR 1 surfaces: ``fleet/route`` spans (replica,
+load, occupancy, attempt), ``fleet/failover`` / ``fleet/restart`` /
+``fleet/scale`` / ``fleet/shed`` event spans, ``fleet/*`` counters, and
+``fleet/replicas`` / ``fleet/queue_depth`` / ``fleet/occupancy``
+gauges; ``python -m cloud_tpu.monitoring.report`` renders them as a
+dedicated fleet section.  ``utils.faults`` seams (``fleet.route``,
+``fleet.replica_start``) let ``scripts/check_fleet.py`` kill and starve
+replicas deterministically.  The same topology deploys to real Cloud
+TPU nodes via ``core.deploy.build_serve_fleet_request``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from cloud_tpu.fleet.autoscaler import AutoscaleConfig, QueueDepthAutoscaler
+from cloud_tpu.fleet.replica import Replica
+from cloud_tpu.fleet.router import LeastLoadedRouter
+from cloud_tpu.monitoring import metrics, tracing
+from cloud_tpu.serving.engine import (
+    DeadlineExceededError,
+    EngineClosedError,
+    QueueFullError,
+    ServeResult,
+)
+from cloud_tpu.utils import faults, retries
+
+logger = logging.getLogger(__name__)
+
+#: Fleet-owned threads (prefix-matched by the leak guards, same family
+#: as the serving engine's ``cloud-tpu-serve-*`` names).
+FLEET_ROUTER_THREAD_NAME = "cloud-tpu-fleet-router"
+FLEET_SUPERVISOR_THREAD_NAME = "cloud-tpu-fleet-supervisor"
+FLEET_DRAIN_THREAD_NAME = "cloud-tpu-fleet-drain"
+
+
+class FleetClosedError(RuntimeError):
+    """The fleet is closed (or closing): the request was not admitted."""
+
+
+class NoReplicaAvailableError(RuntimeError):
+    """No routable replica right now (all restarting, draining, or
+    excluded) — transient by classification: the route policy backs off
+    and retries while the supervisor restores capacity."""
+
+
+def default_route_policy(**overrides) -> retries.RetryPolicy:
+    """The routing/failover budget: enough attempts with short backoff
+    to ride out one replica restart, bounded so a truly dead fleet
+    sheds load typed instead of queueing forever."""
+    args = dict(
+        max_attempts=8, initial_backoff_s=0.05, max_backoff_s=1.0,
+        classify=route_transient,
+    )
+    args.update(overrides)
+    return retries.RetryPolicy(**args)
+
+
+def route_transient(exc: BaseException) -> bool:
+    """Failover classification for routing and completion failures.
+
+    Permanent: an expired deadline (shed, never re-submitted), a closed
+    fleet, and caller errors (bad prompt shape / budget — a retry would
+    fail identically).  Everything else — queue-full, a replica that
+    closed or crashed mid-request, a watchdogged dispatch, an injected
+    chaos fault — is the replica's failure, not the request's, and the
+    request deserves another candidate.
+    """
+    return not isinstance(
+        exc, (DeadlineExceededError, FleetClosedError, ValueError,
+              TypeError),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet knobs: sizing bounds, admission, routing, supervision.
+
+    ``min_replicas`` engines are built at construction;
+    the autoscaler moves the count within ``[min_replicas,
+    max_replicas]``.  ``max_queue``/``admission`` are the fleet-level
+    backpressure contract (same semantics as ``ServeConfig``'s — the
+    engine-level queues stay as the per-replica backstop).
+    ``route_policy`` bounds failover: attempts, backoff, and the
+    transient classification; ``poll_interval_s`` paces the supervisor
+    (jittered ±20% so many fleets never poll in lockstep).
+    """
+
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None  # None: min_replicas (fixed size)
+    max_queue: int = 1024
+    admission: str = "block"
+    route_policy: Optional[retries.RetryPolicy] = None
+    poll_interval_s: float = 0.2
+    #: Bound on any graceful drain (scale-down, restart close, close()).
+    drain_timeout_s: float = 60.0
+    #: Autoscaler thresholds; ``min/max_replicas`` above are authoritative
+    #: (they overwrite the ones in a user-supplied AutoscaleConfig).
+    autoscale: Optional[AutoscaleConfig] = None
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas is None:
+            object.__setattr__(self, "max_replicas", self.min_replicas)
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})"
+            )
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.admission not in ("block", "reject"):
+            raise ValueError(
+                f"admission must be 'block' or 'reject', "
+                f"got {self.admission!r}"
+            )
+        if self.poll_interval_s <= 0:
+            raise ValueError("poll_interval_s must be > 0")
+        base = self.autoscale or AutoscaleConfig()
+        object.__setattr__(self, "autoscale", dataclasses.replace(
+            base, min_replicas=self.min_replicas,
+            max_replicas=self.max_replicas,
+        ))
+
+
+@dataclasses.dataclass
+class _FleetRequest:
+    prompt: np.ndarray
+    max_new_tokens: Optional[int]
+    future: Future
+    submitted: float  # perf_counter
+    deadline: Optional[float] = None
+    #: Replica submits accepted so far (attempt N+1 is failover N).
+    attempts: int = 0
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+    def remaining(self, now: float) -> Optional[float]:
+        return None if self.deadline is None else self.deadline - now
+
+
+class Fleet:
+    """N supervised replicas behind one ``submit()`` (module docstring).
+
+    ``engine_factory`` is any zero-arg callable returning a started
+    engine (``submit``/``health``/``close`` — duck-typed; production
+    passes a lambda over :class:`~cloud_tpu.serving.ServingEngine`).
+    Every replica — initial, restarted, or scaled up — comes from the
+    same factory, which is what makes failover output-invisible: any
+    replica serves any request identically.
+    """
+
+    def __init__(
+        self,
+        engine_factory: Callable[[], object],
+        config: Optional[FleetConfig] = None,
+        *,
+        router: Optional[LeastLoadedRouter] = None,
+        start: bool = True,
+    ):
+        self.config = config or FleetConfig()
+        self._factory = engine_factory
+        self._router = router or LeastLoadedRouter()
+        self._route_policy = (
+            self.config.route_policy
+            if self.config.route_policy is not None
+            else default_route_policy()
+        )
+        self._autoscaler = QueueDepthAutoscaler(self.config.autoscale)
+
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._in_flight = 0
+        self._closed = False
+        self._draining = True
+        self._replicas: List[Replica] = []
+        self._next_replica_id = 0
+        self._router_thread: Optional[threading.Thread] = None
+        self._supervisor_thread: Optional[threading.Thread] = None
+        #: Scale-down drain helpers (joined by close(); the supervisor
+        #: must keep polling health while a victim finishes decoding).
+        self._drainers: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            "submitted": 0, "completed": 0, "failed": 0, "rejected": 0,
+            "shed": 0, "failovers": 0, "restarts": 0,
+            "scale_ups": 0, "scale_downs": 0,
+        }
+        self._routed: Dict[int, int] = {}
+
+        try:
+            for _ in range(self.config.min_replicas):
+                self._new_replica()  # factory failure here IS a
+                # constructor failure: a fleet that cannot build its
+                # minimum capacity must not pretend to be up...
+        except BaseException:
+            # ...but the replicas already built own live engine threads
+            # and no Fleet object will exist to close() them.
+            for replica in self._replicas:
+                try:
+                    replica.close(drain=False)
+                except Exception:  # noqa: BLE001 — best-effort unwind
+                    logger.exception(
+                        "closing replica %d during failed construction",
+                        replica.id,
+                    )
+            raise
+        metrics.gauge_set("fleet/replicas", len(self._replicas))
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Fleet":
+        """Launch the router + supervisor threads (idempotent)."""
+        with self._cond:
+            if self._closed:
+                raise FleetClosedError("fleet already closed")
+            if self._router_thread is not None:
+                return self
+            self._router_thread = threading.Thread(
+                target=self._router_loop, daemon=True,
+                name=FLEET_ROUTER_THREAD_NAME,
+            )
+            self._supervisor_thread = threading.Thread(
+                target=self._supervisor_loop, daemon=True,
+                name=FLEET_SUPERVISOR_THREAD_NAME,
+            )
+            self._router_thread.start()
+            self._supervisor_thread.start()
+        return self
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Stop the fleet: no more admissions, resolve what is owed.
+
+        ``drain=True`` (default) serves every admitted request — the
+        supervisor stays up through the drain so a replica dying
+        mid-drain is still restarted and its requests still fail over —
+        then retires every replica gracefully.  ``drain=False`` fails
+        the fleet queue immediately and closes replicas without drain
+        (their owed requests fail typed).  After return the fleet owns
+        zero live threads (the same hygiene contract as the engine).
+        """
+        with self._cond:
+            self._closed = True
+            self._draining = drain
+            # A never-started fleet has no router to drain through: fail
+            # what waits rather than wait on a thread that never ran.
+            if not drain or self._router_thread is None:
+                self._fail_queue_locked(
+                    FleetClosedError("fleet closed before dispatch")
+                )
+            self._cond.notify_all()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if drain:
+            # Wait for the router (and failover re-entries) to finish
+            # the owed work before tearing supervision down.
+            timed_out = False
+            with self._cond:
+                while self._queue or self._in_flight:
+                    remaining = (
+                        None if deadline is None
+                        else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        timed_out = True
+                        break
+                    self._cond.wait(
+                        0.5 if remaining is None else min(remaining, 0.5)
+                    )
+            if timed_out:
+                # The drain budget is spent: fall back to the hard path
+                # for whatever is left — fail it typed NOW so the router
+                # can observe empty+idle and exit, rather than return
+                # with a live thread and futures that resolve later.
+                drain = False
+                with self._cond:
+                    self._draining = False  # stop failover re-entries
+                    self._fail_queue_locked(FleetClosedError(
+                        f"fleet close(drain=True) timed out after "
+                        f"{timeout}s"
+                    ))
+                    self._cond.notify_all()
+        if not drain:
+            # Replicas first: failing their owed requests is what lets
+            # the router observe in_flight drain to zero and exit.
+            for replica in self.replicas():
+                self._close_replica(replica, drain=False, deadline=deadline)
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        for thread in (self._supervisor_thread, self._router_thread):
+            if thread is not None:
+                thread.join(
+                    None if deadline is None
+                    else max(deadline - time.monotonic(), 0.1)
+                )
+        if drain:
+            for replica in self.replicas():
+                self._close_replica(replica, drain=True, deadline=deadline)
+        for drainer in list(self._drainers):
+            drainer.join(
+                None if deadline is None
+                else max(deadline - time.monotonic(), 0.1)
+            )
+        metrics.gauge_set("fleet/replicas", 0)
+
+    def _close_replica(self, replica: Replica, *, drain: bool,
+                       deadline: Optional[float]) -> None:
+        remaining = (
+            self.config.drain_timeout_s if deadline is None
+            else max(deadline - time.monotonic(), 0.1)
+        )
+        try:
+            replica.close(drain=drain, timeout=remaining)
+        except Exception:  # noqa: BLE001 — teardown must visit them all
+            logger.exception("closing replica %d failed", replica.id)
+
+    def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Future:
+        """Enqueue one prompt; returns a Future of the replica's result
+        (a :class:`~cloud_tpu.serving.ServeResult` for real engines,
+        with ``latency_seconds`` re-based to the *fleet* submit time).
+
+        Same surface as ``ServingEngine.submit``: ``deadline_s`` bounds
+        the total queue wait — fleet queue plus replica queue; the
+        remaining budget travels with the request across failover hops,
+        and an expired request is shed typed, never served late.
+        Thread-safe; blocks or raises :class:`QueueFullError` at
+        ``max_queue`` per the admission policy.
+        """
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1:
+            raise ValueError(
+                f"prompt must be 1-D token ids, got shape {prompt.shape}"
+            )
+        submitted = time.perf_counter()
+        request = _FleetRequest(
+            prompt=prompt, max_new_tokens=max_new_tokens, future=Future(),
+            submitted=submitted,
+            deadline=(
+                None if deadline_s is None else submitted + deadline_s
+            ),
+        )
+        cfg = self.config
+        with self._cond:
+            if self._closed:
+                raise FleetClosedError("fleet is closed")
+            if len(self._queue) >= cfg.max_queue:
+                if cfg.admission == "reject":
+                    with self._stats_lock:
+                        self._stats["rejected"] += 1
+                    metrics.counter_inc("fleet/rejected")
+                    raise QueueFullError(
+                        f"fleet queue full ({cfg.max_queue} waiting); "
+                        "retry with backoff or raise max_queue/max_replicas"
+                    )
+                while len(self._queue) >= cfg.max_queue and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    raise FleetClosedError(
+                        "fleet closed while blocked on admission"
+                    )
+            self._queue.append(request)
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._stats["submitted"] += 1
+        metrics.counter_inc("fleet/requests")
+        return request.future
+
+    # -- router ------------------------------------------------------------
+
+    def _router_loop(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    request = None
+                    while True:
+                        now = time.perf_counter()
+                        self._shed_expired_locked(now)
+                        if self._queue:
+                            request = self._queue.popleft()
+                            # In flight from the POP: a draining close()
+                            # waits on queue+in_flight, and a request
+                            # mid-routing belongs to neither otherwise.
+                            self._in_flight += 1
+                            self._cond.notify_all()  # admission space
+                            break
+                        if self._closed and not self._in_flight:
+                            return
+                        deadline = self._earliest_deadline_locked()
+                        self._cond.wait(
+                            None if deadline is None
+                            else max(deadline - now, 1e-4)
+                        )
+                self._route(request)
+        except BaseException as exc:  # noqa: BLE001 — the dispatcher must
+            # not die silently: refuse new work and fail what waits.
+            logger.exception("fleet router crashed")
+            with self._cond:
+                self._closed = True
+                self._fail_queue_locked(exc)
+                self._cond.notify_all()
+
+    def _earliest_deadline_locked(self) -> Optional[float]:
+        deadlines = [
+            r.deadline for r in self._queue if r.deadline is not None
+        ]
+        return min(deadlines) if deadlines else None
+
+    def _shed_expired_locked(self, now: float) -> int:
+        """Fleet-level deadline shedding: an expired request leaves the
+        queue with a typed failure BEFORE any replica submit (caller
+        holds the lock)."""
+        if not any(r.expired(now) for r in self._queue):
+            return 0
+        kept: collections.deque = collections.deque()
+        shed = 0
+        while self._queue:
+            request = self._queue.popleft()
+            if not request.expired(now):
+                kept.append(request)
+                continue
+            shed += 1
+            tracing.record_span(
+                "fleet/shed", request.submitted, now, reason="deadline",
+            )
+            self._resolve(request, exc=DeadlineExceededError(
+                f"request shed at the fleet after waiting "
+                f"{now - request.submitted:.3f}s; deadline_s="
+                f"{request.deadline - request.submitted:.3f}"
+            ), shed=True)
+        self._queue.extend(kept)
+        if shed:
+            metrics.counter_inc("fleet/shed", shed)
+            self._cond.notify_all()
+        return shed
+
+    def _route(self, request: _FleetRequest) -> None:
+        """One routing pass: pick -> submit, failing over across
+        candidates under the route policy; on success, hook the replica
+        future back into the fleet."""
+        tried: set = set()
+        route_start = time.perf_counter()
+
+        def attempt():
+            now = time.perf_counter()
+            if request.expired(now):
+                # Permanent by classification: shed, never submitted.
+                tracing.record_span(
+                    "fleet/shed", request.submitted, now, reason="deadline",
+                )
+                metrics.counter_inc("fleet/shed")
+                raise DeadlineExceededError(
+                    f"request expired before reaching a replica "
+                    f"({now - request.submitted:.3f}s in the fleet)"
+                )
+            faults.fault_point("fleet.route")
+            with self._cond:
+                if self._closed and not self._draining:
+                    raise FleetClosedError("fleet closed during routing")
+                candidates = list(self._replicas)
+            replica, health = self._router.pick(candidates, exclude=tried)
+            if replica is None:
+                tried.clear()  # widen the next pass: a restarted or
+                # previously-full replica deserves a fresh look.
+                raise NoReplicaAvailableError(
+                    "no routable replica (restarting/draining/unhealthy)"
+                )
+            remaining = request.remaining(time.perf_counter())
+            if remaining is not None and remaining <= 0:
+                raise DeadlineExceededError(
+                    "request expired while routing"
+                )
+            try:
+                inner = replica.engine.submit(
+                    request.prompt,
+                    max_new_tokens=request.max_new_tokens,
+                    deadline_s=remaining,
+                )
+            except (QueueFullError, EngineClosedError) as exc:
+                # This candidate is out; fail over to the next one.
+                tried.add(replica.id)
+                self._record_failover(request, replica, exc)
+                raise
+            return replica, health, inner
+
+        try:
+            replica, health, inner = self._route_policy.call(
+                attempt, name="fleet.route", classify=route_transient,
+            )
+        except BaseException as exc:  # noqa: BLE001 — classified above
+            with self._cond:
+                self._in_flight -= 1
+                self._cond.notify_all()
+            shed = isinstance(exc, DeadlineExceededError)
+            self._resolve(request, exc=exc, shed=shed)
+            return
+        request.attempts += 1
+        now = time.perf_counter()
+        span_attrs = {
+            "replica": replica.id,
+            "load": Replica.load_of(health),
+            "attempt": request.attempts,
+        }
+        occupancy = Replica.occupancy_of(health)
+        if occupancy is not None:
+            span_attrs["occupancy"] = round(occupancy, 4)
+        tracing.record_span("fleet/route", route_start, now, **span_attrs)
+        metrics.counter_inc("fleet/routed")
+        with self._stats_lock:
+            self._routed[replica.id] = self._routed.get(replica.id, 0) + 1
+        inner.add_done_callback(
+            lambda f, req=request, rep=replica: self._on_replica_done(
+                req, rep, f
+            )
+        )
+
+    def _record_failover(self, request: _FleetRequest, replica: Replica,
+                         exc: BaseException) -> None:
+        now = time.perf_counter()
+        tracing.record_span(
+            "fleet/failover", now, now, replica=replica.id,
+            error=type(exc).__name__, attempt=request.attempts,
+        )
+        metrics.counter_inc("fleet/failovers")
+        with self._stats_lock:
+            self._stats["failovers"] += 1
+
+    def _on_replica_done(self, request: _FleetRequest, replica: Replica,
+                         inner: Future) -> None:
+        """Completion hook (runs on the replica's resolving thread):
+        success propagates; a replica-side failure re-enters the fleet
+        queue unless the deadline or the failover budget says stop.
+
+        The in-flight decrement and any re-entry happen under ONE lock
+        acquisition: a draining ``close()`` waits for "queue empty and
+        nothing in flight", and a gap between the two would let it start
+        tearing replicas down with a failover re-entry still landing.
+        """
+        exc = inner.exception()
+        now = time.perf_counter()
+        requeue = False
+        if exc is not None and not isinstance(exc, DeadlineExceededError):
+            requeue = (
+                not request.expired(now)
+                and route_transient(exc)
+                and request.attempts < self._route_policy.max_attempts
+            )
+        with self._cond:
+            self._in_flight -= 1
+            if requeue and not (self._closed and not self._draining):
+                self._record_failover(request, replica, exc)
+                # Front of the queue: the request already waited its
+                # turn once.
+                self._queue.appendleft(request)
+                self._cond.notify_all()
+                return
+            self._cond.notify_all()
+        if exc is None:
+            result = inner.result()
+            if isinstance(result, ServeResult):
+                # Latency the caller actually saw: fleet submit -> done
+                # (includes fleet queueing, routing, and any failover).
+                result = dataclasses.replace(
+                    result,
+                    latency_seconds=time.perf_counter() - request.submitted,
+                )
+            self._resolve(request, result=result)
+            return
+        if isinstance(exc, DeadlineExceededError):
+            # The replica shed it: the deadline verdict stands.
+            self._resolve(request, exc=exc, shed=True)
+            return
+        if request.expired(now):
+            # Failover never re-submits an expired request.
+            self._resolve(request, exc=DeadlineExceededError(
+                f"request expired during failover (replica {replica.id} "
+                f"failed with {type(exc).__name__}: {exc})"
+            ), shed=True)
+            return
+        self._resolve(request, exc=exc)
+
+    def _resolve(self, request: _FleetRequest, *, result=None,
+                 exc: Optional[BaseException] = None,
+                 shed: bool = False) -> None:
+        try:
+            if exc is None:
+                request.future.set_result(result)
+            else:
+                request.future.set_exception(exc)
+        except InvalidStateError:  # pragma: no cover - caller cancelled
+            return
+        with self._stats_lock:
+            if exc is None:
+                self._stats["completed"] += 1
+            elif shed:
+                self._stats["shed"] += 1
+            else:
+                self._stats["failed"] += 1
+        if exc is None:
+            metrics.counter_inc("fleet/completed")
+        elif not shed:
+            metrics.counter_inc("fleet/failed")
+
+    def _fail_queue_locked(self, exc: BaseException) -> None:
+        while self._queue:
+            self._resolve(self._queue.popleft(), exc=exc)
+
+    # -- supervisor --------------------------------------------------------
+
+    def _supervisor_loop(self) -> None:
+        interval = self.config.poll_interval_s
+        while not self._stop.wait(retries.jittered(interval)):
+            try:
+                self._supervise_once()
+            except Exception:  # noqa: BLE001 — supervision must outlive
+                # any single bad poll.
+                logger.exception("fleet supervisor iteration failed")
+
+    def _supervise_once(self) -> None:
+        with self._cond:
+            replicas = list(self._replicas)
+            queue_depth = len(self._queue)
+        ready = 0
+        busy_slots = 0
+        total_slots = 0
+        for replica in replicas:
+            health = replica.health()
+            if replica.state == "ready" and not (
+                health.get("healthy") and health.get("live")
+            ):
+                self._restart_replica(
+                    replica, reason=health.get("reason") or "scheduler dead"
+                )
+                health = replica.health()
+            if replica.state == "dead":
+                # A start/restart that failed earlier: keep trying at
+                # poll cadence until the factory succeeds again.
+                try:
+                    replica.start()
+                    health = replica.health()
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "replica %d start retry failed", replica.id
+                    )
+            if replica.routable(health):
+                ready += 1
+                busy_slots += int(health.get("active_slots") or 0)
+                total_slots += int(health.get("num_slots") or 0)
+                # The backlog the autoscaler sizes against is EVERYTHING
+                # still waiting, wherever it waits: block-admission
+                # replicas absorb the fleet queue into their own, and a
+                # signal that only watched the fleet queue would read a
+                # saturated fleet as idle.
+                queue_depth += int(health.get("queue_depth") or 0)
+        occupancy = busy_slots / total_slots if total_slots else 0.0
+        metrics.gauge_set("fleet/replicas", len(replicas))
+        metrics.gauge_set("fleet/queue_depth", queue_depth)
+        metrics.gauge_set("fleet/occupancy", occupancy)
+        if self._closed:
+            return  # draining: capacity is frozen, only health matters
+        decision = self._autoscaler.observe(
+            queue_depth=queue_depth, ready_replicas=ready,
+            occupancy=occupancy,
+        )
+        if decision == "up":
+            self._scale_up()
+        elif decision == "down":
+            self._scale_down()
+
+    def _restart_replica(self, replica: Replica, *, reason: str) -> None:
+        logger.warning(
+            "fleet: restarting unhealthy replica %d (%s)", replica.id,
+            reason,
+        )
+        start = time.perf_counter()
+        try:
+            replica.restart(close_timeout=self.config.drain_timeout_s)
+        except Exception:  # noqa: BLE001 — retried next poll (state dead)
+            logger.exception("replica %d restart failed", replica.id)
+        tracing.record_span(
+            "fleet/restart", start, time.perf_counter(),
+            replica=replica.id, reason=reason[:200],
+        )
+        metrics.counter_inc("fleet/restarts")
+        with self._stats_lock:
+            self._stats["restarts"] += 1
+
+    def _new_replica(self) -> Replica:
+        with self._cond:
+            rid = self._next_replica_id
+            self._next_replica_id += 1
+        replica = Replica(rid, self._factory)
+        with self._cond:
+            self._replicas.append(replica)
+            self._cond.notify_all()
+        return replica
+
+    def _scale_up(self) -> None:
+        with self._cond:
+            # The autoscaler's bound is on READY replicas (its load
+            # signal), but the sizing contract is on replicas that
+            # exist: a dead-but-owned replica still counts against
+            # max_replicas — its start retry would otherwise overshoot
+            # the bound once it succeeds.
+            if len(self._replicas) >= self.config.max_replicas:
+                return
+        start = time.perf_counter()
+        try:
+            replica = self._new_replica()
+        except Exception:  # noqa: BLE001 — a failed scale-up is a missed
+            # opportunity, not a fleet failure; the window re-fires.
+            logger.exception("fleet scale-up failed")
+            return
+        count = len(self.replicas())
+        tracing.record_span(
+            "fleet/scale", start, time.perf_counter(), direction="up",
+            replica=replica.id, replicas=count,
+        )
+        metrics.counter_inc("fleet/scale_up")
+        metrics.gauge_set("fleet/replicas", count)
+        with self._stats_lock:
+            self._stats["scale_ups"] += 1
+        logger.info("fleet: scaled up to %d replicas", count)
+
+    def _scale_down(self) -> None:
+        """Retire the least-loaded ready replica via graceful drain:
+        removed from the routing set FIRST (no new work), then
+        ``close(drain=True)`` serves everything it already admitted.
+
+        The drain itself runs on a short-lived helper thread (joined by
+        ``close()``): a victim may take up to ``drain_timeout_s`` to
+        finish decoding, and the supervisor must keep polling health —
+        a replica watchdogged DURING the drain window still needs its
+        restart on the next poll, not after the drain.
+        """
+        with self._cond:
+            if len(self._replicas) <= self.config.min_replicas:
+                return
+            candidates = [r for r in self._replicas if r.state == "ready"]
+        # pick() reads engine health (the engine's own lock) — done
+        # OUTSIDE the fleet lock; engine threads resolve futures while
+        # holding theirs and our completion hook takes ours.
+        victim, _ = self._router.pick(candidates)
+        with self._cond:
+            if (
+                victim is None
+                or victim not in self._replicas
+                or len(self._replicas) <= self.config.min_replicas
+            ):
+                return
+            self._replicas.remove(victim)
+        start = time.perf_counter()
+
+        def drain_victim():
+            self._close_replica(victim, drain=True, deadline=None)
+            tracing.record_span(
+                "fleet/scale", start, time.perf_counter(),
+                direction="down", replica=victim.id,
+                replicas=len(self.replicas()),
+            )
+
+        drainer = threading.Thread(
+            target=drain_victim, daemon=True,
+            name=FLEET_DRAIN_THREAD_NAME,
+        )
+        self._drainers.append(drainer)
+        drainer.start()
+        count = len(self.replicas())
+        metrics.counter_inc("fleet/scale_down")
+        metrics.gauge_set("fleet/replicas", count)
+        with self._stats_lock:
+            self._stats["scale_downs"] += 1
+        logger.info(
+            "fleet: draining replica %d out, %d remain", victim.id, count
+        )
+
+    # -- introspection -----------------------------------------------------
+
+    def replicas(self) -> List[Replica]:
+        with self._cond:
+            return list(self._replicas)
+
+    def num_replicas(self) -> int:
+        with self._cond:
+            return len(self._replicas)
+
+    def wait_ready(self, timeout: Optional[float] = None) -> None:
+        """Block until every current replica's engine finished its AOT
+        warmup (no-op for engines without ``wait_ready``)."""
+        for replica in self.replicas():
+            engine = replica.engine
+            if engine is not None and hasattr(engine, "wait_ready"):
+                engine.wait_ready(timeout=timeout)
+
+    def health(self) -> dict:
+        """Fleet-level snapshot: aggregate readiness plus one entry per
+        replica (each the engine's own ``health()`` stamped with
+        replica id and state) — the shape a fleet /healthz serves."""
+        with self._cond:
+            queue_depth = len(self._queue)
+            in_flight = self._in_flight
+            closed = self._closed
+            replicas = list(self._replicas)
+        snapshots = [r.health() for r in replicas]
+        ready = sum(
+            1 for r, h in zip(replicas, snapshots) if r.routable(h)
+        )
+        return {
+            "ready": not closed and ready > 0,
+            "closed": closed,
+            "replicas": snapshots,
+            "num_replicas": len(replicas),
+            "ready_replicas": ready,
+            "queue_depth": queue_depth,
+            "in_flight": in_flight,
+        }
+
+    def stats(self) -> dict:
+        """Counters snapshot plus per-replica routed counts (replica id
+        -> requests routed there, restarts included in identity)."""
+        with self._stats_lock:
+            snap = dict(self._stats)
+            snap["routed"] = dict(self._routed)
+        snap["replicas"] = self.num_replicas()
+        return snap

@@ -1,0 +1,156 @@
+"""One supervised serving replica: an engine plus its lifecycle state.
+
+The fleet never talks to a :class:`~cloud_tpu.serving.ServingEngine`
+directly — it talks to a :class:`Replica`, which owns the engine
+*instance* (the engine object changes identity across restarts; the
+replica id does not) and a small state machine the router and supervisor
+coordinate through:
+
+``starting -> ready -> (restarting -> ready)* -> draining -> dead``
+
+* ``ready`` — the router may submit here.
+* ``restarting`` — the supervisor killed an unhealthy engine and is
+  building a fresh one; the router skips the replica meanwhile.
+* ``draining`` — scale-down in progress: no new routes, admitted
+  requests complete (the engine's graceful ``close(drain=True)``).
+* ``dead`` — no engine (start failed, or the replica was removed); the
+  supervisor retries ``start()`` on its next poll for replicas it still
+  owns.
+
+Engines are produced by an ``engine_factory`` — any zero-arg callable
+returning a started engine-shaped object (``submit``/``health``/
+``close``).  The factory is the whole coupling surface: production
+passes a lambda building a real ``ServingEngine``; tests pass fakes.
+Every (re)start runs through the ``fleet.replica_start`` fault seam so
+the chaos harness can make replica creation fail deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from cloud_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
+
+class Replica:
+    """One slot in the fleet: a stable id, a replaceable engine."""
+
+    def __init__(self, replica_id: int, factory: Callable[[], object],
+                 *, start: bool = True):
+        self.id = replica_id
+        self._factory = factory
+        self._lock = threading.Lock()
+        self.engine: Optional[object] = None
+        self.state = "dead"
+        self.restarts = 0
+        self.started_at: Optional[float] = None
+        if start:
+            self.start()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Replica(id={self.id}, state={self.state!r})"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Build a fresh engine through the factory (and the chaos seam).
+
+        On factory failure the replica stays ``dead`` — the supervisor
+        retries on its next poll rather than the fleet dying with it.
+        """
+        with self._lock:
+            if self.state == "ready":
+                return
+            self.state = "starting"
+        try:
+            faults.fault_point("fleet.replica_start")
+            engine = self._factory()
+        except BaseException:
+            with self._lock:
+                self.state = "dead"
+            raise
+        with self._lock:
+            self.engine = engine
+            self.state = "ready"
+            self.started_at = time.perf_counter()
+
+    def restart(self, *, close_timeout: Optional[float] = None) -> None:
+        """Kill the current (unhealthy) engine and build a fresh one.
+
+        ``close(drain=False)``: an unhealthy engine cannot be drained —
+        its waiting and in-flight requests fail with the engine's typed
+        errors, and the fleet's submit callbacks re-enter them into the
+        fleet queue, so supervision never drops an admitted request.
+        """
+        with self._lock:
+            self.state = "restarting"
+            old, self.engine = self.engine, None
+        if old is not None:
+            try:
+                old.close(drain=False, timeout=close_timeout)
+            except Exception:  # noqa: BLE001 — a broken engine must not
+                # block its own replacement.
+                logger.exception(
+                    "replica %d: closing unhealthy engine failed", self.id
+                )
+        self.restarts += 1
+        self.start()
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Retire the replica: graceful drain (scale-down / fleet close)
+        or immediate failure of everything owed (``drain=False``)."""
+        with self._lock:
+            self.state = "draining" if drain else "dead"
+            engine = self.engine
+        if engine is not None:
+            engine.close(drain=drain, timeout=timeout)
+        with self._lock:
+            self.state = "dead"
+
+    # -- introspection -----------------------------------------------------
+
+    def health(self) -> dict:
+        """The engine's health snapshot, stamped with replica identity.
+
+        A replica without an engine (starting/dead) reports itself
+        unroutable without raising — the supervisor and the router both
+        poll this on every decision.
+        """
+        engine = self.engine
+        if engine is None:
+            return {
+                "healthy": False, "ready": False, "live": False,
+                "reason": f"replica {self.state}", "queue_depth": 0,
+                "active_slots": 0, "num_slots": 0,
+                "replica": self.id, "state": self.state,
+            }
+        snap = engine.health()
+        snap["replica"] = self.id
+        snap["state"] = self.state
+        return snap
+
+    @staticmethod
+    def load_of(health: dict) -> int:
+        """The router's load signal: queued + in-flight work."""
+        return int(health.get("queue_depth") or 0) + int(
+            health.get("active_slots") or 0
+        )
+
+    @staticmethod
+    def occupancy_of(health: dict) -> Optional[float]:
+        """Fraction of the replica's decode slots in use (None when the
+        engine doesn't report a slot count)."""
+        slots = health.get("num_slots")
+        if not slots:
+            return None
+        return int(health.get("active_slots") or 0) / float(slots)
+
+    def routable(self, health: Optional[dict] = None) -> bool:
+        snap = health if health is not None else self.health()
+        return self.state == "ready" and bool(snap.get("ready"))

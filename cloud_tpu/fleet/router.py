@@ -1,0 +1,53 @@
+"""Replica selection: route each request to the least-loaded replica.
+
+The router is a pure policy object — no threads, no locks of its own.
+The fleet's dispatcher calls :meth:`LeastLoadedRouter.pick` once per
+routing attempt with a snapshot of the replica list; the router reads
+each candidate's ``health()`` (a cheap, lock-bounded snapshot — the PR 8
+``queue_depth``/``active_slots`` fields exist exactly so this does not
+have to reach into ``stats()``) and returns the routable replica with
+the smallest load signal::
+
+    load = queue_depth + active_slots
+
+Queue depth is work promised, active slots work in progress; their sum
+is the number of requests ahead of a new arrival, which under identical
+replicas is proportional to its expected wait.  Ties break toward the
+lowest replica id, so a cold fleet fills deterministically.
+
+``exclude`` carries the ids already tried during the current failover
+pass — a replica that just raised ``QueueFullError`` must not be picked
+again until every other candidate had its chance (the fleet clears the
+set once it round-robins through everyone).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+from cloud_tpu.fleet.replica import Replica
+
+
+class LeastLoadedRouter:
+    """Pick the ready replica with the smallest ``queue + active`` load."""
+
+    def pick(self, replicas: Iterable[Replica],
+             exclude: Iterable[int] = (),
+             ) -> Tuple[Optional[Replica], Optional[dict]]:
+        """Return ``(replica, its health snapshot)`` or ``(None, None)``
+        when no routable candidate exists (all excluded, draining,
+        restarting, or unhealthy)."""
+        excluded = set(exclude)
+        best: Optional[Replica] = None
+        best_health: Optional[dict] = None
+        best_load: Optional[int] = None
+        for replica in replicas:
+            if replica.id in excluded:
+                continue
+            health = replica.health()
+            if not replica.routable(health):
+                continue
+            load = Replica.load_of(health)
+            if best_load is None or load < best_load:
+                best, best_health, best_load = replica, health, load
+        return best, best_health

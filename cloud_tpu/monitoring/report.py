@@ -17,6 +17,13 @@ a grid-health line: chunk count, mean slot occupancy, mean active
 slots, and total emitted tokens, aggregated from the per-dispatch span
 attributes the scheduler stamps on every chunk.
 
+Timelines with ``fleet/*`` spans (the ``cloud_tpu.fleet`` layer) get a
+**fleet** section: per-replica routed-request counts with mean
+load/occupancy (from the attributes the router stamps on every
+``fleet/route`` decision), failover / restart / scale-event counts, and
+the occupancy spread across replicas — the imbalance number a fleet
+operator tunes the router against.
+
 Timelines touched by the fault-tolerance layer get a **robustness**
 section: retry activity (``retry/*`` spans — the ``utils.retries``
 policy stamps ``attempts``/``outcome`` on every retried call), shed /
@@ -193,6 +200,84 @@ class TraceReport:
         return {"retries": retries, "shed": shed, "faults": faults,
                 "drains": drains}
 
+    def fleet_summary(self) -> Optional[Dict[str, object]]:
+        """Aggregate the serving-fleet spans into one operations dict.
+
+        ``replicas``: per-replica-id — requests routed there (one
+        ``fleet/route`` span each) plus mean load and mean occupancy
+        from the attributes the router stamps per decision.
+        ``occupancy_spread``: max - min of the per-replica mean
+        occupancies (an unbalanced fleet wastes exactly this much of
+        its best replica's amortization) — None until two replicas
+        report occupancy.  Plus counts of ``fleet/failover``,
+        ``fleet/restart``, ``fleet/shed``, and ``fleet/scale`` events
+        by direction.  None when the timeline has no fleet spans.
+        """
+        replicas: Dict[object, Dict[str, float]] = {}
+        failovers = 0
+        restarts = 0
+        shed = 0
+        scale = {"up": 0, "down": 0}
+        seen = False
+        for event in self.events:
+            name = event.get("name", "")
+            if not name.startswith("fleet/"):
+                continue
+            seen = True
+            args = event.get("args") or {}
+            if name == "fleet/route":
+                row = replicas.setdefault(args.get("replica"), {
+                    "requests": 0, "load_sum": 0.0, "load_n": 0,
+                    "occ_sum": 0.0, "occ_n": 0,
+                })
+                row["requests"] += 1
+                if isinstance(args.get("load"), (int, float)):
+                    row["load_sum"] += args["load"]
+                    row["load_n"] += 1
+                if isinstance(args.get("occupancy"), (int, float)):
+                    row["occ_sum"] += args["occupancy"]
+                    row["occ_n"] += 1
+            elif name == "fleet/failover":
+                failovers += 1
+            elif name == "fleet/restart":
+                restarts += 1
+            elif name == "fleet/shed":
+                shed += 1
+            elif name == "fleet/scale":
+                direction = args.get("direction")
+                if direction in scale:
+                    scale[direction] += 1
+        if not seen:
+            return None
+        per_replica = {}
+        occupancies = []
+        for rid, row in replicas.items():
+            mean_occ = (
+                row["occ_sum"] / row["occ_n"] if row["occ_n"] else None
+            )
+            if mean_occ is not None:
+                occupancies.append(mean_occ)
+            per_replica[rid] = {
+                "requests": int(row["requests"]),
+                "mean_load": (
+                    row["load_sum"] / row["load_n"] if row["load_n"]
+                    else None
+                ),
+                "mean_occupancy": mean_occ,
+            }
+        spread = (
+            max(occupancies) - min(occupancies)
+            if len(occupancies) >= 2 else None
+        )
+        return {
+            "replicas": per_replica,
+            "failovers": failovers,
+            "restarts": restarts,
+            "shed": shed,
+            "scale": scale,
+            "occupancy_spread": spread,
+        }
+
     @staticmethod
     def _render_table(rows, header) -> List[str]:
         table = [header] + rows
@@ -262,6 +347,31 @@ class TraceReport:
             if robustness["drains"]:
                 lines.append(
                     f"  preemption drains: {robustness['drains']}"
+                )
+        fleet = self.fleet_summary()
+        if fleet:
+            lines.append("")
+            lines.append("fleet (routing, supervision, scaling):")
+            for rid in sorted(fleet["replicas"], key=str):
+                row = fleet["replicas"][rid]
+                detail = f"  replica {rid}: {row['requests']} request(s)"
+                if row["mean_load"] is not None:
+                    detail += f", mean load {row['mean_load']:.2f}"
+                if row["mean_occupancy"] is not None:
+                    detail += f", mean occupancy {row['mean_occupancy']:.1%}"
+                lines.append(detail)
+            events_line = (
+                f"  failovers: {fleet['failovers']} · restarts: "
+                f"{fleet['restarts']} · scale up x{fleet['scale']['up']} / "
+                f"down x{fleet['scale']['down']}"
+            )
+            if fleet["shed"]:
+                events_line += f" · shed {fleet['shed']}"
+            lines.append(events_line)
+            if fleet["occupancy_spread"] is not None:
+                lines.append(
+                    f"  occupancy spread across replicas: "
+                    f"{fleet['occupancy_spread']:.1%}"
                 )
         continuous = self.continuous_summary()
         if continuous:

@@ -362,6 +362,10 @@ class ServingEngine:
         #: close() so a finite hang never leaks past the engine's life.
         self._orphan_dispatches: List[threading.Thread] = []
         self._last_dispatch_ts: Optional[float] = None
+        #: Rows of the batch currently on the device (batch scheduler;
+        #: the continuous path reads its slot table instead).  Plain int
+        #: swap — written by the scheduler, read by ``health()``.
+        self._inflight_rows = 0
 
         self._stats_lock = threading.Lock()
         self._stats = {
@@ -883,6 +887,7 @@ class ServingEngine:
                         else max(deadline - now, 1e-4)
                     )
                     self._cond.wait(timeout)
+            self._inflight_rows = len(batch)
             try:
                 self._dispatch(batch)
             except BaseException as exc:  # noqa: BLE001 — per-batch
@@ -901,6 +906,8 @@ class ServingEngine:
                     # the engine down (crash handler fails the queue and
                     # leaves health() unhealthy).
                     raise
+            finally:
+                self._inflight_rows = 0
 
     # -- continuous scheduler ----------------------------------------------
 
@@ -1221,10 +1228,15 @@ class ServingEngine:
         closed engine is still healthy: it stopped, it didn't break).
         ``ready`` — accepting new ``submit()`` calls right now.
         ``live`` — the scheduler thread exists and is running.
-        ``reason`` — why ``healthy`` is False, else None.  Plus queue
-        depth, live/free slot counts (continuous mode), orphaned
-        dispatch count, and seconds since the last device dispatch
-        (None before the first) for staleness alerting.
+        ``reason`` — why ``healthy`` is False, else None.  Plus the
+        load signal a fleet router reads per routing decision —
+        ``queue_depth`` (waiting requests; same value as the legacy
+        ``waiting`` key), ``active_slots`` (decode slots / batch rows on
+        the device right now, both schedulers), ``num_slots`` (the
+        engine's slot capacity, so occupancy is ``active/num``) — the
+        continuous grid's ``free_slots``, orphaned dispatch count, and
+        seconds since the last device dispatch (None before the first)
+        for staleness alerting.
         """
         with self._cond:
             waiting = self._waiting
@@ -1240,13 +1252,18 @@ class ServingEngine:
             "reason": reason,
             "closed": closed,
             "waiting": waiting,
+            "queue_depth": waiting,
+            "active_slots": (
+                len(self._active_slots) if self._continuous
+                else self._inflight_rows
+            ),
+            "num_slots": self.serve_config.num_slots,
             "orphaned_dispatches": len(self._orphan_dispatches),
             "last_dispatch_age_s": (
                 None if last is None else time.perf_counter() - last
             ),
         }
         if self._continuous:
-            snap["active_slots"] = len(self._active_slots)
             snap["free_slots"] = len(self._free_slots)
         return snap
 

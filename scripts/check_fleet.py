@@ -1,0 +1,306 @@
+"""End-to-end fleet check on CPU: parity across a replica kill, provable
+autoscaling, zero leaked threads.
+
+The fleet contracts (docs/fleet.md) are only real if a deterministic
+chaos run proves them — the fleet analogue of ``check_serving.py``'s
+parity harness and ``check_chaos.py``'s degradation harness:
+
+1. **churn + replica kill** — staggered mixed-length churn traffic
+   through a 2-replica fleet of real TINY engines while a
+   ``CLOUD_TPU_FAULT_PLAN`` (exported by ``faults.inject``) hangs one
+   mid-run chunk dispatch past ``dispatch_timeout_s``.  The watchdog
+   kills that replica's engine; its admitted requests must fail over
+   and complete on the surviving replica while the supervisor rebuilds
+   the dead one.  Asserted: EVERY future resolves with token-for-token
+   greedy parity vs per-request ``generation.generate`` (zero admitted
+   requests dropped, failed-over requests serve correct tokens),
+   ``failovers >= 1``, ``restarts >= 1``, and after ``Fleet.close()``
+   no fleet/engine/compile thread survives.
+2. **autoscale** — sustained slow traffic into a ``[1, 3]`` fleet whose
+   single replica has one decode slot: the fleet queue backs up, the
+   autoscaler must scale up; once the backlog drains and the fleet
+   idles, it must drain back down to one replica via graceful drain —
+   with every request still served (parity-checked) and zero leaks.
+
+Prints one JSON line per phase plus a summary::
+
+    {"phase": "summary", "ok": true, "failovers": 2, "scale_ups": 1, ...}
+
+Wired as a ``slow``-marked test in tests/unit/test_fleet.py (same
+pattern as check_serving.py / check_chaos.py), so CI runs it every time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+# CPU by default: a correctness harness, not a perf one.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FLEET_THREAD_PREFIXES = (
+    "cloud-tpu-fleet", "cloud-tpu-serve", "cloud-tpu-compile-ahead",
+)
+
+
+def _fleet_threads():
+    return [
+        t.name for t in threading.enumerate()
+        if t.name.startswith(FLEET_THREAD_PREFIXES)
+    ]
+
+
+def _model():
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import transformer
+
+    config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+    params = transformer.init(jax.random.PRNGKey(0), config)
+    return config, params
+
+
+def _parity_mismatches(params, config, prompts, budgets, results) -> int:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cloud_tpu.models import generation
+
+    mismatches = 0
+    for prompt, budget, result in zip(prompts, budgets, results):
+        direct = generation.generate(
+            params, jnp.asarray(prompt[None, :]),
+            jnp.asarray([len(prompt)], np.int32), config,
+            max_new_tokens=budget,
+            sample=generation.SampleConfig(temperature=0.0),
+        )
+        want = np.asarray(direct["tokens"])[0]
+        if not np.array_equal(result.tokens, want) or (
+            result.num_generated != int(direct["num_generated"][0])
+        ):
+            mismatches += 1
+    return mismatches
+
+
+def check_churn_with_replica_kill(timeout: float) -> dict:
+    """Phase 1: mixed-length churn across 2 replicas; one replica's
+    chunk dispatch hangs mid-run (watchdog kill); zero requests lost."""
+    import numpy as np
+
+    from cloud_tpu.fleet import Fleet, FleetConfig
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils import faults
+
+    config, params = _model()
+    serve = ServeConfig(
+        max_new_tokens=6, prompt_buckets=(8, 16), batch_buckets=(1, 2),
+        num_slots=2, chunk_tokens=2, dispatch_timeout_s=1.0, warmup=True,
+    )
+
+    def factory():
+        return ServingEngine(params, config, serve, mesh=None)
+
+    rng = np.random.default_rng(0)
+    n_requests = 16
+    lens = rng.integers(2, 17, n_requests)
+    budgets = [int(b) for b in rng.integers(2, 7, n_requests)]
+    prompts = [
+        rng.integers(1, 255, int(n)).astype(np.int32) for n in lens
+    ]
+
+    fleet = Fleet(factory, FleetConfig(
+        min_replicas=2, poll_interval_s=0.05,
+    ))
+    fleet.wait_ready(timeout=timeout)
+    # One warm pass outside the fault plan: the kill must race decode
+    # traffic, not a cold compile.
+    fleet.submit(prompts[0], max_new_tokens=budgets[0]).result(
+        timeout=timeout
+    )
+
+    # The replica kill: the 6th chunk dispatch ACROSS the fleet (site
+    # counters are per-process) hangs 3 s — past dispatch_timeout_s=1,
+    # so whichever replica dispatches it is watchdogged and dies with
+    # requests in flight.  inject() exports CLOUD_TPU_FAULT_PLAN, the
+    # same seam a staging rig would set in the environment.
+    plan = [{"site": "serve.chunk", "mode": "hang", "hang_s": 3.0,
+             "nth": 6}]
+    with faults.inject(plan) as active:
+        assert os.environ.get(faults.ENV_FAULT_PLAN), "plan must export"
+        futures = []
+        for i, prompt in enumerate(prompts):
+            futures.append(
+                fleet.submit(prompt, max_new_tokens=budgets[i])
+            )
+            if (i + 1) % 4 == 0:
+                time.sleep(0.05)  # staggered waves keep slots churning
+        results = [f.result(timeout=timeout) for f in futures]
+    # The traffic can finish (failed over to the survivor) before the
+    # supervisor is done rebuilding the killed replica — its kill-close
+    # must first join the injected 3 s hang.  Supervision's contract is
+    # eventual: wait for it to converge before asserting on it.
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        stats = fleet.stats()
+        health = fleet.health()
+        if stats["restarts"] >= 1 and health["ready_replicas"] == 2:
+            break
+        time.sleep(0.05)
+    fleet.close()
+    leaked = _fleet_threads()
+
+    mismatches = _parity_mismatches(params, config, prompts, budgets,
+                                    results)
+    return {
+        "phase": "churn_replica_kill",
+        "ok": (
+            mismatches == 0
+            and active.fired() == {"serve.chunk": 1}
+            and stats["failovers"] >= 1
+            and stats["restarts"] >= 1
+            and stats["failed"] == 0
+            and stats["completed"] == n_requests + 1  # incl. warm pass
+            and health["ready_replicas"] == 2  # supervisor rebuilt it
+            and not leaked
+        ),
+        "mismatches": mismatches,
+        "faults_fired": active.fired(),
+        "failovers": stats["failovers"],
+        "restarts": stats["restarts"],
+        "completed": stats["completed"],
+        "routed": {str(k): v for k, v in stats["routed"].items()},
+        "leaked_threads": leaked,
+    }
+
+
+def check_autoscale(timeout: float) -> dict:
+    """Phase 2: sustained queue depth scales the fleet up; idleness
+    drains it back down — all requests served with parity."""
+    import numpy as np
+
+    from cloud_tpu.fleet import AutoscaleConfig, Fleet, FleetConfig
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+
+    from cloud_tpu.fleet import default_route_policy
+
+    config, params = _model()
+    # One decode slot, a tiny reject-admission queue, and a real
+    # per-request budget: a single replica saturates fast and says so
+    # typed, so the backlog stays at the FLEET — where a scaled-up
+    # replica can actually absorb it via failover.
+    serve = ServeConfig(
+        max_new_tokens=8, prompt_buckets=(8,), batch_buckets=(1,),
+        num_slots=1, chunk_tokens=2, warmup=True,
+        admission="reject", max_queue=2,
+    )
+
+    def factory():
+        return ServingEngine(params, config, serve, mesh=None)
+
+    fleet = Fleet(factory, FleetConfig(
+        min_replicas=1, max_replicas=3, poll_interval_s=0.05,
+        # A generous failover budget: the head request may retry against
+        # a saturated fleet for a few hundred ms until capacity frees or
+        # the autoscaler adds it.
+        route_policy=default_route_policy(
+            max_attempts=20, initial_backoff_s=0.02, max_backoff_s=0.2,
+        ),
+        autoscale=AutoscaleConfig(
+            scale_up_queue_depth=2.0, window=2, idle_window=6,
+            cooldown=2,
+        ),
+    ))
+    fleet.wait_ready(timeout=timeout)
+
+    rng = np.random.default_rng(1)
+    n_requests = 24
+    prompts = [
+        rng.integers(1, 255, int(rng.integers(2, 9))).astype(np.int32)
+        for _ in range(n_requests)
+    ]
+    budgets = [8] * n_requests
+    futures = [
+        fleet.submit(p, max_new_tokens=8) for p in prompts
+    ]
+
+    # Scale-up must happen while the backlog is live.
+    deadline = time.perf_counter() + timeout
+    peak = 1
+    while time.perf_counter() < deadline:
+        peak = max(peak, fleet.num_replicas())
+        if peak > 1 and all(f.done() for f in futures):
+            break
+        time.sleep(0.02)
+    results = [f.result(timeout=timeout) for f in futures]
+
+    # ...and the idle fleet must drain back to the floor.
+    while fleet.num_replicas() > 1 and time.perf_counter() < deadline:
+        time.sleep(0.02)
+    settled = fleet.num_replicas()
+    stats = fleet.stats()
+    fleet.close()
+    leaked = _fleet_threads()
+
+    mismatches = _parity_mismatches(params, config, prompts, budgets,
+                                    results)
+    return {
+        "phase": "autoscale",
+        "ok": (
+            mismatches == 0
+            and stats["scale_ups"] >= 1
+            and stats["scale_downs"] >= 1
+            and peak >= 2
+            and settled == 1
+            and stats["completed"] == n_requests
+            and stats["failed"] == 0
+            and not leaked
+        ),
+        "mismatches": mismatches,
+        "peak_replicas": peak,
+        "settled_replicas": settled,
+        "scale_ups": stats["scale_ups"],
+        "scale_downs": stats["scale_downs"],
+        "completed": stats["completed"],
+        "leaked_threads": leaked,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--timeout", type=float, default=240.0,
+                        help="per-phase wait budget (seconds)")
+    args = parser.parse_args(argv)
+
+    start = time.perf_counter()
+    phases = [
+        check_churn_with_replica_kill(args.timeout),
+        check_autoscale(args.timeout),
+    ]
+    for phase in phases:
+        print(json.dumps(phase), flush=True)
+    ok = all(p["ok"] for p in phases)
+    print(json.dumps({
+        "phase": "summary",
+        "ok": ok,
+        "failovers": phases[0]["failovers"],
+        "restarts": phases[0]["restarts"],
+        "scale_ups": phases[1]["scale_ups"],
+        "scale_downs": phases[1]["scale_downs"],
+        "leaked_threads": (
+            phases[0]["leaked_threads"] + phases[1]["leaked_threads"]
+        ),
+        "wall_seconds": round(time.perf_counter() - start, 3),
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -354,6 +354,64 @@ class TestWarmup:
         )
 
 
+class TestHealth:
+    """The health() load-signal contract (ISSUE 8): the fleet router
+    reads ``queue_depth``/``active_slots``/``num_slots`` off every
+    routing decision, so the keys are pinned here — for BOTH schedulers
+    — alongside the pre-existing readiness keys, which must stay
+    stable."""
+
+    #: Keys the PR 6 consumers (check_chaos, external supervisors)
+    #: already depend on.
+    STABLE_KEYS = (
+        "healthy", "ready", "live", "reason", "closed", "waiting",
+        "orphaned_dispatches", "last_dispatch_age_s",
+    )
+
+    def _assert_load_signal(self, health, serve):
+        for key in self.STABLE_KEYS:
+            assert key in health, key
+        assert health["queue_depth"] == health["waiting"]
+        assert isinstance(health["active_slots"], int)
+        assert health["active_slots"] >= 0
+        assert health["num_slots"] == serve.num_slots
+
+    def test_continuous_health_carries_load_signal(self, model):
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(1, 2),
+            chunk_tokens=1,
+        )
+        with ServingEngine(params, config, serve) as engine:
+            health = engine.health()
+            self._assert_load_signal(health, serve)
+            assert health["queue_depth"] == 0
+            assert health["active_slots"] == 0
+            assert health["free_slots"] == serve.num_slots
+            engine.submit(np.asarray([1, 2], np.int32)).result(timeout=120)
+            self._assert_load_signal(engine.health(), serve)
+
+    def test_batch_health_carries_load_signal(self, model):
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(2,),
+            flush_deadline_s=30.0, scheduler="batch",
+        )
+        engine = ServingEngine(params, config, serve, start=False)
+        try:
+            # Two queued requests, scheduler not running: the queue
+            # depth is deterministic.
+            engine.submit(np.asarray([1, 2], np.int32))
+            engine.submit(np.asarray([3], np.int32))
+            health = engine.health()
+            self._assert_load_signal(health, serve)
+            assert health["queue_depth"] == 2
+            assert health["active_slots"] == 0  # nothing dispatched yet
+            assert "free_slots" not in health  # continuous-only key
+        finally:
+            engine.close(drain=False)
+
+
 class TestObservability:
     def test_serve_spans_and_metrics_recorded(self, model):
         from cloud_tpu.monitoring import metrics, tracing

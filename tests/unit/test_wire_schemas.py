@@ -144,6 +144,38 @@ class TestTpuV2Pins:
         for body in request["nodes"].values():
             validate(TPU_SCHEMA, "Node", body)
 
+    def test_serve_fleet_bodies_match_schema_and_are_independent(self):
+        """The ISSUE 8 serve-job spec: every replica node matches the
+        service schema, dials ITS OWN coordinator (independent process
+        groups — the unit the fleet supervisor recreates), restarts
+        process ids at 0, and carries the fleet labels."""
+        plan = planner.plan_mesh(chief_config=TPU)
+        request = deploy.build_serve_fleet_request(
+            "gcr.io/p/img:1", TPU, 3, plan, job_id="fleet",
+            job_labels={"team": "x"},
+        )
+        assert request["role"] == "serve-fleet"
+        assert sorted(request["nodes"]) == [
+            "fleet-r0", "fleet-r1", "fleet-r2"
+        ]
+        for i, (node_id, body) in enumerate(sorted(
+            request["nodes"].items()
+        )):
+            validate(TPU_SCHEMA, "Node", body)
+            script = body["metadata"]["startup-script"]
+            # Replica i's coordinator is replica i's own host 0 — not
+            # the training topology's shared slice-0 coordinator.
+            assert f"{node_id}-w0:8476" in script
+            assert body["labels"]["cloud_tpu_role"] == "serve-replica"
+            assert body["labels"]["cloud_tpu_replica"] == str(i)
+            assert body["labels"]["cloud_tpu_job"] == "fleet"
+            assert body["labels"]["team"] == "x"
+
+    def test_serve_fleet_rejects_empty_fleet(self):
+        plan = planner.plan_mesh(chief_config=TPU)
+        with pytest.raises(ValueError, match="num_replicas"):
+            deploy.build_serve_fleet_request("img", TPU, 0, plan)
+
     def test_deploy_urls_match_vendored_methods(self):
         """Every call deploy_job + supervise_job + delete_job makes must
         resolve to a vendored TPU v2 method — including the supervisor's
