@@ -773,6 +773,65 @@ def _measure_fleet(extras):
     )
 
 
+def _measure_durability(extras):
+    """Durability probe on the CIFAR workload (the headline's state):
+
+    ``checkpoint_save_blocking_seconds`` — the blocking half of the
+    async checkpoint save (host gather + handoff + previous-save wait +
+    manifest commit), which is exactly what a training step pays at a
+    save boundary; and ``resume_restore_seconds`` — the wall-clock of a
+    verified walk-back restore into a fresh state, what a preempted
+    node pays before its first resumed step.
+    """
+    import shutil
+    import tempfile
+    import types
+
+    from cloud_tpu.training.checkpoint import (
+        CheckpointManager,
+        resume_trainer_state,
+    )
+    from cloud_tpu.utils.benchmarking import resnet_train_setup
+
+    _, state, _ = resnet_train_setup(
+        imagenet_shape=False, batch_size=BATCH_SIZE
+    )
+    tmp = tempfile.mkdtemp(prefix="cloud_tpu_bench_ckpt_")
+    try:
+        manager = CheckpointManager(tmp, max_to_keep=2)
+        # Save 1 primes the pipeline; save 2 is the steady-state number:
+        # it waits out save 1's async tail, commits save 1's manifest
+        # (the full-lineage hash), and hands off its own write — the
+        # whole stall a training step pays at a save boundary.
+        manager.save(1, state)
+        start = time.perf_counter()
+        manager.save(2, state)
+        extras["checkpoint_save_blocking_seconds"] = round(
+            time.perf_counter() - start, 4
+        )
+        manager.wait()  # save 2's async tail + manifest, off the step path
+        manager.close()
+
+        holder = types.SimpleNamespace(state=state)
+        restore_manager = CheckpointManager(tmp)
+        start = time.perf_counter()
+        # quarantine=False: a measurement probe must be read-only.
+        ok = resume_trainer_state(holder, restore_manager,
+                                  only_if_ahead=False, quarantine=False)
+        extras["resume_restore_seconds"] = round(
+            time.perf_counter() - start, 4
+        )
+        restore_manager.close()
+        if not ok:
+            raise RuntimeError("durability probe could not restore the "
+                               "checkpoint it just wrote")
+        extras["durability_config"] = (
+            "resnet50_cifar state, async save + verified walk-back restore"
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _child_main() -> int:
     """Headline first; every phase prints its own salvageable JSON line."""
     # Span tracing on for the whole child: compile vs measure wall-clock
@@ -837,6 +896,7 @@ def _child_main() -> int:
         (_measure_serving, "serving"),
         (_measure_serving_churn, "serving_churn"),
         (_measure_fleet, "fleet"),
+        (_measure_durability, "durability"),
     ):
         phase_extras = {"peak_bf16_tflops": extras.get("peak_bf16_tflops")}
         try:

@@ -106,8 +106,13 @@ def _maybe_restore(trainer, state_dir: str) -> bool:
 
             # only_if_ahead=False: a user-uploaded state saved at step
             # 0 (pretrained weights) must replace the fresh init too.
+            # quarantine=False: state_dir is the USER'S upload, not this
+            # job's save directory — a restore hiccup must never relocate
+            # their checkpoint (saves go to output/checkpoint, so the
+            # stale-newer-step save trap cannot arise here).
             return resume_trainer_state(
-                trainer, CheckpointManager(state_dir), only_if_ahead=False
+                trainer, CheckpointManager(state_dir), only_if_ahead=False,
+                quarantine=False,
             )
         except Exception:
             logger.exception("could not restore from %s; starting fresh",

@@ -28,9 +28,12 @@ Timelines touched by the fault-tolerance layer get a **robustness**
 section: retry activity (``retry/*`` spans — the ``utils.retries``
 policy stamps ``attempts``/``outcome`` on every retried call), shed /
 deadline-exceeded serving requests (``serve/shed``), injected chaos
-faults (``fault/<site>`` spans from ``utils.faults``), and preemption
-drains (``preempt/drain``) — so a post-mortem of "what went wrong and
-what absorbed it" reads off the same CLI as the latency breakdown.
+faults (``fault/<site>`` spans from ``utils.faults``), preemption
+drains (``preempt/drain``), checkpoint restore fallbacks from the
+verified walk-back (``checkpoint/fallback``), non-finite step
+quarantine activity (``train/nonfinite_skip``), and divergence
+rollbacks (``train/rollback``) — so a post-mortem of "what went wrong
+and what absorbed it" reads off the same CLI as the latency breakdown.
 """
 
 from __future__ import annotations
@@ -166,13 +169,25 @@ class TraceReport:
         span, so these are exactly the interesting calls).
         ``shed``: deadline-exceeded serving requests (``serve/shed``).
         ``faults``: injected chaos faults per site (``fault/<site>``).
-        ``drains``: preemption drains (``preempt/drain``).  None when
-        the timeline shows no robustness activity at all.
+        ``drains``: preemption drains (``preempt/drain``).
+        ``restore_fallbacks``: checkpoints skipped by the verified
+        walk-back restore (``checkpoint/fallback`` — corrupt, partial,
+        or unrestorable steps the resume stepped past).
+        ``nonfinite``: the non-finite step quarantine —
+        ``{"windows": N, "steps": M}`` from ``train/nonfinite_skip``
+        spans (N bad dispatch windows, M skipped state updates).
+        ``rollbacks``: divergence rollbacks to the last verified
+        checkpoint (``train/rollback``).  None when the timeline shows
+        no robustness activity at all.
         """
         retries: Dict[str, Dict[str, int]] = {}
         faults: Dict[str, int] = {}
         shed = 0
         drains = 0
+        restore_fallbacks = 0
+        nonfinite_windows = 0
+        nonfinite_steps = 0
+        rollbacks = 0
         for event in self.events:
             name = event.get("name", "")
             args = event.get("args") or {}
@@ -195,10 +210,27 @@ class TraceReport:
                 )
             elif name == "preempt/drain":
                 drains += 1
-        if not retries and not faults and not shed and not drains:
+            elif name == "checkpoint/fallback":
+                restore_fallbacks += 1
+            elif name == "train/nonfinite_skip":
+                nonfinite_windows += 1
+                skipped = args.get("skipped")
+                nonfinite_steps += (
+                    int(skipped) if isinstance(skipped, (int, float)) else 1
+                )
+            elif name == "train/rollback":
+                rollbacks += 1
+        if (not retries and not faults and not shed and not drains
+                and not restore_fallbacks and not nonfinite_windows
+                and not rollbacks):
             return None
-        return {"retries": retries, "shed": shed, "faults": faults,
-                "drains": drains}
+        return {
+            "retries": retries, "shed": shed, "faults": faults,
+            "drains": drains, "restore_fallbacks": restore_fallbacks,
+            "nonfinite": {"windows": nonfinite_windows,
+                          "steps": nonfinite_steps},
+            "rollbacks": rollbacks,
+        }
 
     def fleet_summary(self) -> Optional[Dict[str, object]]:
         """Aggregate the serving-fleet spans into one operations dict.
@@ -347,6 +379,22 @@ class TraceReport:
             if robustness["drains"]:
                 lines.append(
                     f"  preemption drains: {robustness['drains']}"
+                )
+            if robustness["restore_fallbacks"]:
+                lines.append(
+                    f"  checkpoint restore fallbacks (walk-back): "
+                    f"{robustness['restore_fallbacks']}"
+                )
+            nonfinite = robustness["nonfinite"]
+            if nonfinite["windows"]:
+                lines.append(
+                    f"  non-finite updates skipped: {nonfinite['steps']} "
+                    f"step(s) over {nonfinite['windows']} window(s)"
+                )
+            if robustness["rollbacks"]:
+                lines.append(
+                    f"  divergence rollbacks to verified checkpoint: "
+                    f"{robustness['rollbacks']}"
                 )
         fleet = self.fleet_summary()
         if fleet:

@@ -1,29 +1,112 @@
-"""Async checkpointing via Orbax, with chief-aware save semantics.
+"""Async checkpointing via Orbax, with chief-aware save semantics and a
+durable-resume layer: integrity manifests, verified walk-back restore,
+and composite iterator/rng state.
 
 The reference delegated checkpointing to user code with chief-only save
 paths and non-chief throwaway dirs (cloud_fit/remote.py:130-145,
 testdata/save_and_load.py).  Orbax handles multi-host coordination natively
 (every process participates in writing its shards), so the "throwaway dir"
-dance disappears; what remains chief-only is bookkeeping like metric files.
+dance disappears; what remains chief-only is bookkeeping like metric files
+— and this module's integrity manifests.
+
+Durability model (docs/robustness.md "Durable resume"):
+
+* Every completed save gets a **manifest** (``manifest.cloud-tpu.json``
+  inside the step dir): per-file byte size + streamed crc32 over every
+  file Orbax wrote.  The manifest is written with an atomic rename, so
+  its presence IS the commit marker — a step without one was never
+  proven durable.  Composite extras (iterator state) ride in a
+  synchronous ``meta/`` sidecar that survives kills the manifest
+  doesn't.
+* Manifests are finalized when the async write is known complete: at the
+  NEXT ``save()``, and at ``wait()``/``close()``.  A hard kill between a
+  save and its finalize leaves that step unmanifested (restorable, but
+  not verified).
+* :meth:`CheckpointManager.verify` replays the manifest against disk —
+  ``"verified"`` / ``"corrupt"`` / ``"unmanifested"`` — and
+  :func:`resume_trainer_state` **walks back** latest→older until an
+  intact step restores, quarantining corrupt/partial step dirs instead
+  of throwing away all progress because the newest write died.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
-from typing import Any, Optional
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional
 
 from cloud_tpu.monitoring import metrics, tracing
 from cloud_tpu.utils import faults
 
 logger = logging.getLogger(__name__)
 
+#: The integrity manifest, inside each step dir.  Written via atomic
+#: rename AFTER the async save completes: presence == commit marker.
+MANIFEST_NAME = "manifest.cloud-tpu.json"
+
+#: Sidecar dir (under the checkpoint root) holding per-step composite
+#: extras — iterator state and friends — written SYNCHRONOUSLY at save
+#: time (they must reflect the trainer's position at that step, and they
+#: are tiny).  Kept outside the step dir because Orbax owns that layout
+#: until the async write commits.
+META_DIRNAME = "meta"
+
+#: Where corrupt/partial step dirs are moved (never deleted in place:
+#: quarantined dirs keep the forensics while getting out of the resume
+#: path).  Pruned to the manager's ``max_to_keep``.
+QUARANTINE_DIRNAME = "quarantine"
+
+_VERIFIED = "verified"
+_CORRUPT = "corrupt"
+_UNMANIFESTED = "unmanifested"
+
+
+def _is_chief() -> bool:
+    try:
+        from cloud_tpu.parallel import distributed
+
+        return distributed.is_chief()
+    except Exception:  # noqa: BLE001 — single-process until proven otherwise
+        return True
+
+
+#: Streaming-read granularity for manifest hashing: bounds peak memory
+#: at one chunk regardless of how large an Orbax shard file is.
+_HASH_CHUNK_BYTES = 8 * 1024 * 1024
+
+
+def _file_crc32(path: str) -> "tuple":
+    """(crc32, size) of a file, streamed in bounded chunks.
+
+    zlib.crc32 (C-speed, incremental) rather than the records layer's
+    one-shot crc32c: manifest files can be multi-GB Orbax shards, and
+    reading them whole to hash would add an OOM-class allocation to the
+    save path.  The algorithm is private to the manifest format.
+    """
+    import zlib
+
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_HASH_CHUNK_BYTES)
+            if not chunk:
+                return crc, size
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+
 
 class CheckpointManager:
     """Thin wrapper over orbax.checkpoint.CheckpointManager.
 
-    Keeps the framework's surface stable if orbax's API shifts, and adds
-    the trainer Callback adapter.
+    Keeps the framework's surface stable if orbax's API shifts, adds the
+    trainer Callback adapter, and layers the durability contract on top:
+    integrity manifests with a commit marker, ``verify()``, quarantine of
+    damaged step dirs, and composite per-step extras (iterator state).
     """
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,
@@ -31,6 +114,7 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
 
         self._directory = os.fspath(directory)
+        self._max_to_keep = max_to_keep
         self._manager = ocp.CheckpointManager(
             self._directory,
             options=ocp.CheckpointManagerOptions(
@@ -39,21 +123,89 @@ class CheckpointManager:
                 enable_async_checkpointing=True,
             ),
         )
+        #: Steps whose async save was started but whose manifest has not
+        #: been written yet (finalized at next save / wait / close).
+        self._pending_manifest: List[int] = []
+        #: In-flight background manifest hashing (started at a save
+        #: boundary once the previous async write is known complete, so
+        #: the full-lineage read+crc overlaps training instead of
+        #: stalling the step path; joined at the next boundary).
+        self._finalize_thread: Optional[threading.Thread] = None
 
     @property
     def directory(self) -> str:
         return self._directory
 
-    def save(self, step: int, state: Any) -> bool:
+    # -- local-path helpers (manifests are local-fs only for now; GCS
+    # checkpoints stay unmanifested and restore through the legacy path).
+
+    def _is_local(self) -> bool:
+        return not self._directory.startswith("gs://")
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._directory, str(int(step)))
+
+    def _meta_path(self, step: int) -> str:
+        return os.path.join(self._directory, META_DIRNAME, f"{int(step)}.json")
+
+    def save(self, step: int, state: Any, *, extras: Optional[Dict] = None,
+             force: bool = False) -> bool:
+        """Start an async save; returns orbax's saved/skipped bool.
+
+        ``extras`` is a small JSON-able dict saved alongside the step
+        (composite checkpoint: the trainer's iterator state rides here)
+        and handed back by :meth:`read_extras`.
+
+        ``force=True`` bypasses orbax's ``save_interval_steps`` policy —
+        the policy is modulo-based, so a save at an off-multiple step (a
+        preemption-drain emergency save, a fused-dispatch window that
+        CROSSED the interval without landing on a multiple) would
+        otherwise be silently skipped.  A force at an already-saved step
+        downgrades to the plain call (orbax raises StepAlreadyExists
+        under force; without it the duplicate is a no-op).
+
+        The span covers the blocking half of the async pipeline: waiting
+        out the PREVIOUS save (and joining its manifest hash, which had
+        the whole inter-save interval to finish in the background) plus
+        the host gather/handoff of this one — exactly the cost a
+        training step pays at a save boundary.
+        """
         import orbax.checkpoint as ocp
 
-        # Async checkpointing: the span covers the blocking half (host
-        # gather + handoff), which is exactly the cost training pays.
         with tracing.span("checkpoint/save", step=int(step)):
             # Chaos seam: a crashed/hung save surfaces here — the same
             # place a full disk or a GCS outage would.
             faults.fault_point("checkpoint.save")
-            return self._manager.save(step, args=ocp.args.StandardSave(state))
+            # The previous async save is complete before orbax starts a
+            # new one anyway; waiting explicitly first means the steps
+            # handed to the background finalize below have known-final
+            # files.
+            self._manager.wait_until_finished()
+            self._join_finalize()
+            ready, self._pending_manifest = self._pending_manifest, []
+            latest = self._manager.latest_step()
+            if force and latest is not None and int(step) == int(latest):
+                force = False
+            try:
+                saved = self._manager.save(
+                    step, args=ocp.args.StandardSave(state), force=force,
+                )
+            except BaseException:
+                # This save failing must not drop the COMPLETED earlier
+                # steps' manifests with it: put them back so the next
+                # save/wait/close (possibly on a rebuilt manager's
+                # sibling) still commits them.
+                self._pending_manifest = ready + self._pending_manifest
+                raise
+            if saved:
+                self._write_meta(int(step), extras)
+                self._pending_manifest.append(int(step))
+            if ready:
+                # Hash + commit the completed earlier saves on a worker:
+                # a multi-GB lineage read must overlap training, not
+                # extend this save's blocking half.
+                self._start_finalize(ready)
+            return saved
 
     def restore(self, step: Optional[int] = None, *, template: Any = None):
         import orbax.checkpoint as ocp
@@ -72,52 +224,448 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._manager.latest_step()
 
+    def steps(self) -> List[int]:
+        """All step numbers currently on disk, ascending (re-read, so a
+        quarantine or an out-of-band delete is reflected)."""
+        try:
+            self._manager.reload()
+        except Exception:  # noqa: BLE001 — older orbax without reload()
+            logger.debug("orbax manager reload failed", exc_info=True)
+        return sorted(int(s) for s in self._manager.all_steps())
+
     def wait(self) -> None:
         self._manager.wait_until_finished()
+        self._finalize_pending()
 
     def close(self) -> None:
+        try:
+            self.wait()
+        except Exception:  # noqa: BLE001 — closing is best-effort
+            logger.debug("wait-before-close failed", exc_info=True)
         self._manager.close()
+
+    # -- manifest / verify / quarantine ---------------------------------
+
+    def _write_meta(self, step: int, extras: Optional[Dict]) -> None:
+        """Synchronous tiny sidecar: the composite extras must reflect
+        the trainer's position AT the save call, and must survive a hard
+        kill even if the manifest never commits."""
+        if not extras or not self._is_local() or not _is_chief():
+            return
+        try:
+            meta_dir = os.path.join(self._directory, META_DIRNAME)
+            os.makedirs(meta_dir, exist_ok=True)
+            tmp = self._meta_path(step) + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(extras, f)
+            os.replace(tmp, self._meta_path(step))
+        except Exception:  # noqa: BLE001 — extras are riders, not cargo
+            logger.exception("could not write checkpoint extras for step %d",
+                             step)
+
+    def read_extras(self, step: int) -> Optional[Dict]:
+        """The composite extras saved with ``step`` (None if absent)."""
+        if not self._is_local():
+            return None
+        try:
+            with open(self._meta_path(step), encoding="utf-8") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError):
+            logger.warning("unreadable checkpoint extras for step %d", step,
+                           exc_info=True)
+            return None
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._step_dir(step), MANIFEST_NAME)
+
+    def _join_finalize(self) -> None:
+        thread, self._finalize_thread = self._finalize_thread, None
+        if thread is not None:
+            thread.join()
+
+    def _start_finalize(self, steps: List[int]) -> None:
+        # The meta prune must spare steps whose save is still in flight:
+        # their dir is an orbax tmp name until the async write commits,
+        # but their sidecar is already on disk.
+        keep = frozenset(str(s) for s in steps) | frozenset(
+            str(s) for s in self._pending_manifest
+        )
+        thread = threading.Thread(
+            target=self._finalize_steps, args=(steps, keep), daemon=True,
+            name="cloud-tpu-ckpt-manifest",
+        )
+        self._finalize_thread = thread
+        thread.start()
+
+    def _finalize_pending(self) -> None:
+        """Synchronously commit every outstanding manifest (wait/close:
+        the durability barrier before the process may exit)."""
+        self._join_finalize()
+        pending, self._pending_manifest = self._pending_manifest, []
+        self._finalize_steps(pending, frozenset(str(s) for s in pending))
+
+    def _finalize_steps(self, pending: List[int],
+                        keep: frozenset = frozenset()) -> None:
+        """Write manifests for saves whose async write has completed.
+
+        Only called with steps for which ``wait_until_finished`` has
+        returned, so their files are final.  A manifest that cannot be
+        written leaves its step unmanifested (restorable, unverified) —
+        never kills training.  Chief-only: one process hashes, the
+        manifest covers the whole (shared-fs) step dir.
+        """
+        if not self._is_local():
+            return
+        if not _is_chief():
+            return
+        for step in pending:
+            step_dir = self._step_dir(step)
+            if not os.path.isdir(step_dir):
+                continue  # save failed or was GC'd already
+            try:
+                manifest = self._build_manifest(step, step_dir)
+                # Chaos seam: a commit that dies here leaves the step
+                # unmanifested — exactly what a kill at this instant does.
+                faults.fault_point("checkpoint.commit")
+                tmp = self._manifest_path(step) + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(manifest, f)
+                os.replace(tmp, self._manifest_path(step))  # commit marker
+            except Exception:  # noqa: BLE001 — durability layer must not
+                # take training down; the step just stays uncommitted.
+                logger.exception(
+                    "could not commit manifest for checkpoint step %d", step
+                )
+        if pending:
+            self._prune_meta(keep)
+
+    def _build_manifest(self, step: int, step_dir: str) -> Dict:
+        entries: Dict[str, Dict[str, int]] = {}
+        for root, _dirs, files in os.walk(step_dir):
+            for name in sorted(files):
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, step_dir)
+                if rel == MANIFEST_NAME or rel == MANIFEST_NAME + ".tmp":
+                    continue
+                crc, size = _file_crc32(path)
+                entries[rel] = {"bytes": size, "crc32": crc}
+        return {"step": int(step), "committed": True, "entries": entries}
+
+    def verify(self, step: int) -> str:
+        """Replay the manifest against disk.
+
+        Returns ``"verified"`` (manifest present, every entry's size and
+        crc32 match), ``"corrupt"`` (manifest present but unreadable, an
+        entry missing, or bytes changed), or ``"unmanifested"`` (no
+        manifest — a pre-durability checkpoint, a GCS dir, or a save
+        whose commit a hard kill interrupted).
+        """
+        status = self._verify_on_disk(step)
+        # Chaos seam: a corrupt-mode rule can force any verdict.
+        return faults.fault_point("checkpoint.verify", status)
+
+    def _verify_on_disk(self, step: int) -> str:
+        if not self._is_local():
+            return _UNMANIFESTED
+        path = self._manifest_path(step)
+        if not os.path.exists(path):
+            return _UNMANIFESTED
+        try:
+            with open(path, encoding="utf-8") as f:
+                manifest = json.load(f)
+            entries = manifest["entries"]
+        except (OSError, ValueError, KeyError):
+            logger.warning("unreadable manifest for step %d", step,
+                           exc_info=True)
+            return _CORRUPT
+        step_dir = self._step_dir(step)
+        for rel, want in entries.items():
+            file_path = os.path.join(step_dir, rel)
+            try:
+                crc, size = _file_crc32(file_path)
+            except OSError:
+                logger.warning("checkpoint step %d: missing entry %r",
+                               step, rel)
+                return _CORRUPT
+            if size != want.get("bytes"):
+                logger.warning(
+                    "checkpoint step %d: %r is %d bytes, manifest says %s",
+                    step, rel, size, want.get("bytes"),
+                )
+                return _CORRUPT
+            if crc != want.get("crc32"):
+                logger.warning("checkpoint step %d: %r fails its manifest "
+                               "crc32", step, rel)
+                return _CORRUPT
+        return _VERIFIED
+
+    def quarantine(self, step: int) -> bool:
+        """Move a damaged step dir out of the resume path.
+
+        The dir lands under ``quarantine/`` (kept for forensics, pruned
+        to ``max_to_keep`` entries oldest-first) and the orbax manager is
+        reloaded so ``latest_step`` stops pointing at it.  Removing a
+        walked-past step from the lineage is load-bearing, not hygiene:
+        orbax skips any ``save(step)`` not ahead of ``latest_step``, so
+        a stale newer dir left in place would silently disable every
+        checkpoint save of the resumed job until it passed that step.
+
+        Chief-only in multi-host jobs (one mover on the shared
+        filesystem); non-chief processes just reload, so the chief's
+        move is reflected in their step listing.
+        """
+        step_dir = self._step_dir(step)
+        if not _is_chief():
+            try:
+                self._manager.reload()
+            except Exception:  # noqa: BLE001
+                logger.debug("orbax manager reload failed", exc_info=True)
+            return not os.path.isdir(step_dir)
+        if not os.path.isdir(step_dir):
+            return False
+        qdir = os.path.join(self._directory, QUARANTINE_DIRNAME)
+        try:
+            os.makedirs(qdir, exist_ok=True)
+            dst = os.path.join(
+                qdir, f"step-{int(step)}-{int(time.time() * 1000)}"
+            )
+            shutil.move(step_dir, dst)
+        except OSError:
+            logger.exception("could not quarantine checkpoint step %d; "
+                             "deleting instead", step)
+            try:
+                shutil.rmtree(step_dir)
+            except OSError:
+                logger.exception("could not delete checkpoint step %d", step)
+                return False
+        metrics.counter_inc("checkpoint/quarantined")
+        try:
+            meta = self._meta_path(step)
+            if os.path.exists(meta):
+                os.remove(meta)
+        except OSError:
+            logger.debug("meta cleanup failed for step %d", step,
+                         exc_info=True)
+        self._gc_quarantine(qdir)
+        try:
+            self._manager.reload()
+        except Exception:  # noqa: BLE001 — stale cache only affects
+            # latest_step hints; steps() re-reads anyway.
+            logger.debug("orbax manager reload failed", exc_info=True)
+        logger.warning("quarantined checkpoint step %d under %s", step, qdir)
+        return True
+
+    def _gc_quarantine(self, qdir: str) -> None:
+        # Prune by QUARANTINE time, not dir mtime: shutil.move preserves
+        # the step dir's original mtime, so an ancient step quarantined
+        # just now would sort oldest and delete the very forensics being
+        # collected.  quarantine() embeds its wall-clock (ms) in the dst
+        # name; dirs without the suffix fall back to mtime (same unit).
+        def _quarantined_at(entry: str) -> float:
+            tail = entry.rsplit("-", 1)[-1]
+            if tail.isdigit():
+                return float(tail)
+            return os.path.getmtime(os.path.join(qdir, entry)) * 1000.0
+
+        try:
+            entries = sorted(
+                (e for e in os.listdir(qdir)
+                 if os.path.isdir(os.path.join(qdir, e))),
+                key=_quarantined_at,
+            )
+            for stale in entries[:-self._max_to_keep or None]:
+                shutil.rmtree(os.path.join(qdir, stale), ignore_errors=True)
+        except OSError:
+            logger.debug("quarantine GC failed", exc_info=True)
+
+    def _prune_meta(self, keep: frozenset = frozenset()) -> None:
+        """Drop extras sidecars for steps no longer on disk (orbax's
+        max_to_keep GC removes the step dirs; the riders go with them).
+        Reads the filesystem directly — this may run on the finalize
+        worker, and poking the orbax manager from a second thread while
+        a save is in flight is not safe.  ``keep`` lists steps whose
+        async save may not have committed its (still tmp-named) dir yet
+        but whose sidecar is already written."""
+        meta_dir = os.path.join(self._directory, META_DIRNAME)
+        if not os.path.isdir(meta_dir):
+            return
+        try:
+            live = {name for name in os.listdir(self._directory)
+                    if name.isdigit()} | set(keep)
+            for name in os.listdir(meta_dir):
+                stem, ext = os.path.splitext(name)
+                if ext == ".json" and stem not in live:
+                    os.remove(os.path.join(meta_dir, name))
+        except OSError:
+            logger.debug("meta prune failed", exc_info=True)
+
+
+def _state_template(state):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+        ),
+        state,
+    )
+
+
+def _restore_matching_rng(manager: CheckpointManager, step: int, current):
+    """Restore ``step`` into the current TrainState's structure.
+
+    A stochastic state (``current.rng`` not None) first tries the full
+    template so the saved rng chain comes back bit-exact; a checkpoint
+    written without the rng leaf (the other ``stochastic`` setting, or a
+    pre-durability save) falls back to the rng-less template with the
+    fresh state's key carried forward — the legacy contract.
+    """
+    if current.rng is not None:
+        try:
+            restored = manager.restore(
+                step, template=_state_template(current)
+            )
+            if restored.rng is None:
+                # A checkpoint saved WITHOUT the rng leaf (deterministic
+                # run, or a pre-durability save) restores leniently with
+                # an empty rng: carry the fresh key forward — the legacy
+                # stochastic-flip contract.
+                restored = restored.replace(rng=current.rng)
+            return restored
+        except Exception:  # noqa: BLE001 — structure mismatch: no rng leaf
+            logger.info(
+                "checkpoint step %d restore with rng template failed; "
+                "retrying without (the rng chain restarts from the fresh "
+                "key)", step, exc_info=True,
+            )
+    restored = manager.restore(
+        step, template=_state_template(current.replace(rng=None))
+    )
+    return restored.replace(rng=current.rng)
+
+
+def _note_fallback(step: int, reason: str) -> None:
+    metrics.counter_inc("checkpoint/fallbacks")
+    now = time.perf_counter()
+    tracing.record_span("checkpoint/fallback", now, now, step=int(step),
+                        reason=reason)
 
 
 def resume_trainer_state(trainer, manager: CheckpointManager, *,
-                         only_if_ahead: bool = True) -> bool:
-    """Restore the latest checkpoint into ``trainer.state``.
+                         only_if_ahead: bool = True,
+                         apply_data_state: bool = False,
+                         quarantine: bool = True) -> bool:
+    """Restore the newest INTACT checkpoint into ``trainer.state``.
 
-    The ONE shared resume recipe (used by :class:`CheckpointCallback` and
-    cloud_fit's server): restores WITHOUT the rng leaf — a checkpoint
-    written under the other ``stochastic`` setting has a different
-    TrainState structure there, and a structure mismatch would otherwise
-    fail the restore; the fresh state's key (or None) carries forward.
-    The template keeps each leaf's shape/dtype/sharding, so a sharded
-    state restores straight into its mesh layout.  Any restore failure
-    logs and returns False (train from the fresh state) rather than
-    killing the job at startup.
+    The ONE shared resume recipe (used by :class:`CheckpointCallback`,
+    cloud_fit's server, and the non-finite rollback path).  Candidates
+    are walked latest→older:
 
-    ``only_if_ahead`` (the preemption-recovery default) skips a
-    checkpoint not ahead of the current state.  cloud_fit passes False:
-    a user-uploaded state saved at step 0 (pretrained weights for a
+    * a step whose manifest fails :meth:`CheckpointManager.verify` is
+      quarantined and skipped (``checkpoint/fallbacks`` counter +
+      ``checkpoint/fallback`` span each time);
+    * an unmanifested step (pre-durability save, or a commit a hard kill
+      interrupted) is restored optimistically; if the restore raises it
+      is quarantined as a partial write and the walk continues;
+    * a VERIFIED step whose restore still raises (template mismatch, a
+      transient) is quarantined too — its bytes stay available under
+      ``quarantine/`` for forensics, but it cannot stay in the lineage:
+      orbax refuses to save any step not ahead of ``latest_step``, so a
+      walked-past step left in place would silently turn every
+      subsequent save (periodic AND the preemption-drain save) into a
+      no-op until the resumed job passed it.
+
+    Only when every candidate fails does the function log "starting
+    fresh" and return False — a single corrupt newest write no longer
+    throws away the intact older checkpoints sitting next to it.
+
+    The restore template is the trainer's own state, so each leaf keeps
+    its shape/dtype/sharding (a sharded state restores straight into its
+    mesh layout).  A stochastic state's rng chain restores bit-exactly
+    when the checkpoint carries it (see :func:`_restore_matching_rng`).
+
+    ``only_if_ahead`` (the preemption-recovery default) skips checkpoints
+    not ahead of the current state.  cloud_fit passes False: a
+    user-uploaded state saved at step 0 (pretrained weights for a
     fine-tune) must still replace the server's fresh init.
+
+    ``apply_data_state=True`` additionally hands the checkpoint's saved
+    iterator state (:meth:`CheckpointManager.read_extras`) to the
+    trainer (``trainer._resume_data_state``), so the next ``fit()``
+    resumes the data stream exactly where the restored step left it —
+    the exactly-once contract ``CheckpointCallback(resume_data=True)``
+    opts into.
+
+    ``quarantine=False`` makes the walk-back purely read-only: failed
+    candidates are skipped (counted + spanned) but never moved.  For a
+    directory the caller does not own — cloud_fit restoring a USER'S
+    uploaded state dir, a benchmark probe — relocating someone else's
+    checkpoint on a restore hiccup would be data loss, and the
+    stale-newer-step save trap the default guards against (see
+    :meth:`CheckpointManager.quarantine`) only exists when this same
+    directory later receives saves.
     """
     if trainer.state is None:
         return False
-    latest = manager.latest_step()
-    if latest is None:
-        return False
-    if only_if_ahead and latest <= int(trainer.state.step):
-        return False
     current = trainer.state
+    current_step = int(current.step)
     try:
-        import jax
-
-        template = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
-            ),
-            current.replace(rng=None),
-        )
-        restored = manager.restore(latest, template=template)
-        trainer.state = restored.replace(rng=current.rng)
-        if int(current.step) == 0:
+        candidates = [
+            s for s in sorted(manager.steps(), reverse=True)
+            if not (only_if_ahead and s <= current_step)
+        ]
+    except Exception:  # noqa: BLE001 — unreadable dir: fresh start
+        logger.exception("could not list checkpoints in %r",
+                         manager.directory)
+        return False
+    if not candidates:
+        return False
+    for step in candidates:
+        try:
+            status = manager.verify(step)
+        except Exception:  # noqa: BLE001 — chaos or IO error in verify
+            logger.exception("checkpoint verify raised at step %d; "
+                             "skipping it", step)
+            _note_fallback(step, "verify_error")
+            # A walked-past step must leave the lineage like every other
+            # failure mode: left in place, a stale NEWER dir would make
+            # orbax silently skip every save of the resumed run (its
+            # bytes survive under quarantine/ if the error was benign).
+            if quarantine:
+                manager.quarantine(step)
+            continue
+        if status == _CORRUPT:
+            logger.error(
+                "checkpoint step %d failed integrity verification; "
+                "walking back", step,
+            )
+            _note_fallback(step, "corrupt")
+            if quarantine:
+                manager.quarantine(step)
+            continue
+        try:
+            restored = _restore_matching_rng(manager, step, current)
+        except Exception:  # noqa: BLE001 — walk back instead of dying
+            logger.exception(
+                "could not restore checkpoint step %d (%s); walking back",
+                step, status,
+            )
+            _note_fallback(step, "restore_failed")
+            # Even a VERIFIED step must leave the lineage once walked
+            # past (see quarantine() docstring: a stale newer step would
+            # make orbax skip every save of the resumed run).
+            if quarantine:
+                manager.quarantine(step)
+            continue
+        trainer.state = restored
+        if apply_data_state:
+            extras = manager.read_extras(step) or {}
+            data_state = extras.get("data_state")
+            if isinstance(data_state, dict):
+                trainer._resume_data_state = dict(data_state)
+        if current_step == 0 and only_if_ahead:
             # A resume REPLACING a step-0 init is either the intended
             # preemption recovery or a reused directory silently hijacking
             # a fresh experiment (ADVICE r4) — loud enough to notice,
@@ -126,23 +674,22 @@ def resume_trainer_state(trainer, manager: CheckpointManager, *,
                 "resumed from checkpoint step %d in %r, REPLACING this "
                 "run's fresh step-0 state; if this is a new experiment "
                 "reusing an old directory, pass resume=False (or clear "
-                "the directory)", latest, manager.directory,
+                "the directory)", step, manager.directory,
             )
         else:
-            logger.info("resumed from checkpoint step %d", latest)
+            logger.info("resumed from checkpoint step %d (%s)", step, status)
         return True
-    except Exception:  # noqa: BLE001 — fresh start beats a dead job
-        logger.exception(
-            "could not restore latest checkpoint (step %s); starting fresh",
-            latest,
-        )
-        return False
+    logger.error(
+        "no intact checkpoint in %r (%d candidate(s) failed verification "
+        "or restore); starting fresh", manager.directory, len(candidates),
+    )
+    return False
 
 
 class CheckpointCallback:
     """Trainer callback: save every N steps and at train end.
 
-    ``resume=True`` (default) restores the latest checkpoint into
+    ``resume=True`` (default) restores the newest intact checkpoint into
     ``trainer.state`` at train begin when one exists AND is ahead of the
     current state — the preemption-recovery contract: a recreated node
     re-runs the same script, whose fresh state is at step 0, and training
@@ -150,16 +697,32 @@ class CheckpointCallback:
     (``deploy.supervise_job`` docstring).  A fresh run with an empty
     directory is untouched, so the default is safe.  The restore template
     is the trainer's own state (same Trainer config => same TrainState
-    structure).
+    structure), and a corrupt newest checkpoint walks back to an older
+    intact one (:func:`resume_trainer_state`).
+
+    ``resume_data=True`` opts into the exactly-once composite resume:
+    each save carries the trainer's iterator position (epoch +
+    consumed-batch index, counted at the trainer boundary) and a resumed
+    ``fit()`` continues the data stream — and the rng chain — bit-exactly
+    from the restored step, finishing the ORIGINAL epochs budget instead
+    of running ``epochs`` fresh ones.  Off by default because it changes
+    what ``fit(epochs=N)`` means after a restore (absolute position, not
+    N more epochs).
     """
 
     def __init__(self, directory: str, *, every_n_steps: int = 100,
-                 max_to_keep: int = 3, resume: bool = True):
+                 max_to_keep: int = 3, resume: bool = True,
+                 resume_data: bool = False):
         self.directory = directory
         self.every_n_steps = every_n_steps
         self.max_to_keep = max_to_keep
         self.resume = resume
+        self.resume_data = resume_data
         self._manager: Optional[CheckpointManager] = None
+        #: Last step observed by on_step_end — fused dispatch (k>1)
+        #: reports only window-boundary steps, so the periodic trigger
+        #: fires on interval CROSSINGS, not on exact multiples.
+        self._prev_step: Optional[int] = None
 
     # Lazily create the manager so the callback object stays cloudpickleable
     # before/after training (managers hold thread pools).
@@ -187,17 +750,53 @@ class CheckpointCallback:
         except Exception:  # noqa: BLE001 — already failing
             logger.debug("failed manager close", exc_info=True)
 
+    @staticmethod
+    def _extras(trainer) -> Optional[Dict]:
+        data_state = getattr(trainer, "data_state", None)
+        if not isinstance(data_state, dict):
+            return None
+        return {"data_state": dict(data_state)}
+
     def on_train_begin(self, trainer):
-        if not self.resume or trainer.state is None:
-            return
-        resume_trainer_state(trainer, self._get())
+        if self.resume_data and not self._get()._is_local():
+            # The meta/ sidecar carrying iterator state is local-fs only
+            # (like the manifests): on a non-local directory the composite
+            # resume silently loses its data half — say so loudly instead.
+            logger.warning(
+                "CheckpointCallback(resume_data=True) on non-local %r: "
+                "iterator state is NOT saved or restored there — a resumed "
+                "fit restarts the data stream (exactly-once resume needs a "
+                "local checkpoint directory)", self.directory,
+            )
+        if self.resume and trainer.state is not None:
+            resume_trainer_state(trainer, self._get(),
+                                 apply_data_state=self.resume_data)
+        # Arm the interval-crossing detector AFTER a possible restore, so
+        # a resumed run measures crossings from its restored step.
+        self._prev_step = (
+            int(trainer.state.step) if trainer.state is not None else None
+        )
 
     def on_epoch_begin(self, epoch, trainer): ...
 
     def on_step_end(self, step, logs, trainer):
-        if step % self.every_n_steps == 0:
+        # Fire when the interval was CROSSED, not only on exact
+        # multiples: a fused dispatch (steps_per_dispatch=k) reports
+        # steps k apart, and the modulo check alone would silently
+        # degrade the save cadence to lcm(k, every_n_steps).
+        prev, self._prev_step = self._prev_step, step
+        every = self.every_n_steps
+        on_multiple = step % every == 0
+        crossed = on_multiple or (
+            prev is not None and step // every > prev // every
+        )
+        if crossed:
             try:
-                self._get().save(step, trainer.state)
+                # force: an off-multiple crossing step would be skipped
+                # by orbax's own modulo interval policy.
+                self._get().save(step, trainer.state,
+                                 extras=self._extras(trainer),
+                                 force=not on_multiple)
             except Exception:  # noqa: BLE001 — a periodic save is
                 # redundancy, not the product: a transient failure
                 # (full disk blip, GCS 503, injected chaos) must not
@@ -213,13 +812,44 @@ class CheckpointCallback:
 
     def on_epoch_end(self, epoch, logs, trainer): ...
 
+    def rollback_state(self, trainer) -> bool:
+        """Restore the newest intact checkpoint into ``trainer.state``,
+        even if it is BEHIND the current step — the trainer's non-finite
+        quarantine calls this to rewind a diverged run to its last
+        verified state (the data stream keeps its current position: the
+        batches that diverged it are not replayed)."""
+        try:
+            manager = self._get()
+            manager.wait()  # an in-flight async save must land first
+            return resume_trainer_state(
+                trainer, manager, only_if_ahead=False, apply_data_state=False
+            )
+        except Exception:  # noqa: BLE001 — the caller terminates instead
+            logger.exception("rollback restore failed")
+            return False
+
     def on_train_end(self, trainer):
+        state = getattr(trainer, "state", None)
+        if state is None:
+            # A fit aborted before producing state (resume crash, empty
+            # dataset edge) still drains through on_train_end; dying HERE
+            # would mask the original failure.
+            logger.warning(
+                "CheckpointCallback.on_train_end: trainer has no state "
+                "(fit aborted before producing one); skipping final save"
+            )
+            return
         # The train-end save is the preemption drain's one shot at not
         # losing work: a single transient failure gets one retry with a
         # fresh manager before it is allowed to take the job down.
+        extras = self._extras(trainer)
         try:
             manager = self._get()
-            manager.save(int(trainer.state.step), trainer.state)
+            # force: the drain/final step is rarely a multiple of
+            # every_n_steps, and orbax's modulo interval policy would
+            # silently skip it — losing up to every_n_steps of work on
+            # the one save that exists to prevent exactly that.
+            manager.save(int(state.step), state, extras=extras, force=True)
         except Exception:  # noqa: BLE001 — retried once, then strict
             logger.exception(
                 "train-end checkpoint save failed; retrying once with a "
@@ -227,7 +857,7 @@ class CheckpointCallback:
             )
             self._reset_manager_after_failure()
             manager = self._get()
-            manager.save(int(trainer.state.step), trainer.state)
+            manager.save(int(state.step), state, extras=extras, force=True)
         manager.wait()
         manager.close()
         self._manager = None

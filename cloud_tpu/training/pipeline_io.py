@@ -190,6 +190,7 @@ def prefetch_to_device(
         return PrefetchIterator(source, place_counted, size)
 
     factory._cloud_tpu_prefetched = True  # Trainer: don't double-wrap
+    _forward_data_state(factory, dataset)
     return factory
 
 
@@ -212,6 +213,16 @@ def _bounded(source: Iterator, limit: int) -> Iterator:
         close = getattr(source, "close", None)
         if close is not None:
             close()
+
+
+def _forward_data_state(factory, dataset) -> None:
+    """Expose the wrapped dataset's exactly-once resume hooks on the
+    factory, so a pre-wrapped dataset handed to ``Trainer.fit`` can still
+    be fast-forwarded by a restored iterator state."""
+    for name in ("state_dict", "load_state_dict"):
+        hook = getattr(dataset, name, None)
+        if hook is not None:
+            setattr(factory, name, hook)
 
 
 def is_prefetched(dataset) -> bool:
@@ -347,6 +358,7 @@ def prefetch_windows(
         )
 
     factory._cloud_tpu_prefetched = True
+    _forward_data_state(factory, dataset)
     return factory
 
 
@@ -367,4 +379,5 @@ def iter_windows(
         for window in windowed(iter(dataset()), steps_per_dispatch, limit):
             yield place(window)
 
+    _forward_data_state(factory, dataset)
     return factory

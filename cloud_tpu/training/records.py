@@ -30,12 +30,16 @@ from __future__ import annotations
 
 import glob as glob_lib
 import io
+import itertools
+import logging
 import os
 import struct
 import threading
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 # ---------------------------------------------------------------------------
 # crc32c (Castagnoli) — required by the TFRecord framing.  Hot path lives
@@ -589,6 +593,15 @@ class RecordDataset:
     pure-Python codecs the pool is ~30% slower (GIL-bound decode gains no
     parallelism, pays submit overhead).  The win case is C-backed
     decompression: JPEG/PNG decode, zlib, np-heavy augmentation.
+
+    Resume: shuffle order (file order AND buffer draws) is derived per
+    epoch from ``(seed, epoch)``, and ``state_dict()`` /
+    ``load_state_dict()`` implement the exactly-once fast-forward
+    contract shared with :class:`~cloud_tpu.training.data.ArrayDataset`
+    — a restored trainer replays epoch E from its B-th batch with the
+    identical stream an uninterrupted run would have produced.  Skipped
+    batches are still decoded (the shuffle-buffer state must advance
+    identically) but never collated or yielded.
     """
 
     def __init__(
@@ -616,7 +629,9 @@ class RecordDataset:
         self.drop_remainder = drop_remainder
         self.verify = verify
         self._storage_client = storage_client
-        self._rng = np.random.default_rng(seed)
+        self.seed = int(seed)
+        self._epoch = 0  # epochs issued so far (next __call__ uses this)
+        self._skip = 0   # one-shot batch fast-forward for the next epoch
         if shard_by_process:
             if process_index is None or process_count is None:
                 import jax
@@ -634,7 +649,7 @@ class RecordDataset:
             self.shard_files = list(self.files)
             self._stride_records = True
 
-    def _payloads(self) -> Iterator[bytes]:
+    def _payloads(self, rng: np.random.Generator) -> Iterator[bytes]:
         files = list(self.shard_files)
         # In record-striding mode the keep predicate depends on the GLOBAL
         # record index, which is only consistent across hosts when every
@@ -642,7 +657,7 @@ class RecordDataset:
         # there would silently break shard disjointness for differently
         # seeded hosts.  Shuffling still happens via the example buffer.
         if self.shuffle_buffer and not self._stride_records:
-            self._rng.shuffle(files)
+            rng.shuffle(files)
         idx = 0
         for path in files:
             for payload in read_records(
@@ -656,9 +671,12 @@ class RecordDataset:
                 if keep:
                     yield payload
 
-    def _examples(self) -> Iterator[Dict[str, np.ndarray]]:
+    def _examples(self, rng: np.random.Generator, payloads=None
+                  ) -> Iterator[Dict[str, np.ndarray]]:
+        if payloads is None:
+            payloads = self._payloads(rng)
         if self.decode_threads <= 0:
-            for payload in self._payloads():
+            for payload in payloads:
                 yield self.decode(payload)
             return
         # Ordered parallel decode: submit up to threads*4 payloads ahead,
@@ -670,28 +688,63 @@ class RecordDataset:
         inflight: "collections.deque" = collections.deque()
         max_inflight = self.decode_threads * 4
         with ThreadPoolExecutor(max_workers=self.decode_threads) as pool:
-            for payload in self._payloads():
+            for payload in payloads:
                 inflight.append(pool.submit(self.decode, payload))
                 if len(inflight) >= max_inflight:
                     yield inflight.popleft().result()
             while inflight:
                 yield inflight.popleft().result()
 
-    def _shuffled(self) -> Iterator[Dict[str, np.ndarray]]:
+    def _shuffled(self, rng: np.random.Generator
+                  ) -> Iterator[Dict[str, np.ndarray]]:
         if not self.shuffle_buffer:
-            yield from self._examples()
+            yield from self._examples(rng)
             return
         buf: List[Dict[str, np.ndarray]] = []
-        for example in self._examples():
+        for example in self._examples(rng):
             buf.append(example)
             if len(buf) >= self.shuffle_buffer:
-                pick = self._rng.integers(len(buf))
+                pick = rng.integers(len(buf))
                 buf[pick], buf[-1] = buf[-1], buf[pick]
                 yield buf.pop()
-        self._rng.shuffle(buf)
+        rng.shuffle(buf)
         yield from buf
 
+    def state_dict(self) -> Dict[str, int]:
+        """Reproducibility state (the trainer records the authoritative
+        consumed-batch position; this is the dataset-side complement)."""
+        return {"epoch": self._epoch, "seed": self.seed}
+
+    def load_state_dict(self, state: Dict) -> None:
+        """Fast-forward: the next iterator produces epoch
+        ``state["epoch"]`` with its first ``state["batches_consumed"]``
+        batches skipped (positions come from the trainer-boundary count
+        a checkpoint recorded, so prefetched-but-unconsumed batches are
+        not marked done).  A ``seed`` in the state is ADOPTED (with a
+        loud warning on mismatch): the position only names the right
+        batches under the shuffle order it was recorded in."""
+        saved = state.get("seed")
+        if saved is not None and int(saved) != self.seed:
+            logger.warning(
+                "restored iterator position was recorded under shuffle "
+                "seed %s but this dataset was built with seed %d; "
+                "adopting the checkpoint's seed so the replayed stream "
+                "is the one the position points into", saved, self.seed,
+            )
+            self.seed = int(saved)
+        self._epoch = int(state.get("epoch", 0))
+        self._skip = int(state.get("batches_consumed", 0))
+
     def __call__(self) -> Iterator[Dict[str, np.ndarray]]:
+        # Epoch/skip captured eagerly so a prefetcher that builds the
+        # iterator without pulling still advances the epoch counter.
+        epoch = self._epoch
+        self._epoch += 1
+        skip, self._skip = self._skip, 0
+        return self._iter_epoch(epoch, skip)
+
+    def _iter_epoch(self, epoch: int, skip: int
+                    ) -> Iterator[Dict[str, np.ndarray]]:
         # Pipeline throughput producers (default exporter telemetry, like
         # the trainer's MetricsCallback): per-batch counter bumps are a
         # ctypes call each — noise against decode cost — and the
@@ -716,15 +769,41 @@ class RecordDataset:
         # abandoned prefetch) suspends the generator at the yield and
         # GCs it — counting after the yield would drop the last batch
         # and skip the tail flush.
+        rng = np.random.default_rng((self.seed, epoch))
+        skipped = 0
+        if skip and not self.shuffle_buffer:
+            # No shuffle-buffer state to advance: fast-forward at the
+            # RECORD level instead of the example level.  The framing is
+            # still read (crc verify and stride accounting unchanged) but
+            # skipped batches are never decoded — at a deep resume point
+            # that is the difference between a seek-speed fast-forward
+            # and re-decoding hours of JPEG/zlib just to discard it.
+            payloads = self._payloads(rng)
+            for _ in itertools.islice(payloads, skip * self.batch_size):
+                pass  # a stream shorter than the skip yields nothing, as before
+            source = self._examples(rng, payloads)
+            skipped = skip  # already skipped; the loop below starts live
+        else:
+            source = self._shuffled(rng)
         try:
             batch: List[Dict[str, np.ndarray]] = []
-            for example in self._shuffled():
+            for example in source:
                 batch.append(example)
                 if len(batch) == self.batch_size:
+                    if skipped < skip:
+                        # Resume fast-forward: the batch was already
+                        # consumed by the interrupted run — advance the
+                        # stream (shuffle state included) without
+                        # collating, accounting, or yielding it.
+                        skipped += 1
+                        batch = []
+                        continue
                     account(self.batch_size)
                     yield self._collate(batch)
                     batch = []
             if batch and not self.drop_remainder:
+                if skipped < skip:
+                    return
                 account(len(batch))
                 yield self._collate(batch)
         finally:

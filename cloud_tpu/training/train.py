@@ -118,12 +118,21 @@ def _build_step_fn(
     mesh: Optional[Mesh] = None,
     stochastic: bool = False,
     accum_steps: int = 1,
+    skip_nonfinite: bool = False,
 ):
     """The un-jitted ``step(state, batch) -> (state, metrics)`` body.
 
     Shared by :func:`make_train_step` (one step per dispatch) and
     :func:`make_multi_step` (K steps scanned inside one dispatch) so the
     two paths cannot drift numerically.
+
+    ``skip_nonfinite=True`` wraps the optimizer update in an on-device
+    ``lax.cond`` on the loss/grad-norm being finite: a step whose batch
+    produced NaN/Inf leaves params, opt_state, and the carried rng-split
+    pattern untouched (the step counter still advances — the batch WAS
+    consumed) and reports ``metrics["nonfinite"] = 1.0``.  No host sync
+    is added; the trainer's quarantine logic reads the flag off the
+    returned metrics like any other.  Donation semantics are unchanged.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -216,18 +225,40 @@ def _build_step_fn(
             )
         else:
             (_, metrics), grads = _grad_fn(step_rng)(state.params, batch)
-        updates, new_opt_state = optimizer.update(
-            grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
-        if mesh is not None and logical_axes is not None:
-            new_params = _constrain(new_params, logical_axes, rules, mesh)
+        metrics = dict(metrics)
+        grad_norm = optax.global_norm(grads)
+        metrics["grad_norm"] = grad_norm
+
+        def apply_update(operand):
+            op_grads, op_params, op_opt_state = operand
+            updates, new_opt = optimizer.update(
+                op_grads, op_opt_state, op_params
+            )
+            new_params = optax.apply_updates(op_params, updates)
+            if mesh is not None and logical_axes is not None:
+                new_params = _constrain(new_params, logical_axes, rules, mesh)
+            return new_params, new_opt
+
+        if skip_nonfinite:
+            finite = jnp.isfinite(grad_norm)
+            loss = metrics.get("loss")
+            if loss is not None:
+                finite = finite & jnp.all(jnp.isfinite(loss))
+            # cond, not select: the poisoned update never executes, so a
+            # skipped step cannot smear NaN into params via 0*inf terms.
+            new_params, new_opt_state = jax.lax.cond(
+                finite, apply_update, lambda op: (op[1], op[2]),
+                (grads, state.params, state.opt_state),
+            )
+            metrics["nonfinite"] = 1.0 - finite.astype(jnp.float32)
+        else:
+            new_params, new_opt_state = apply_update(
+                (grads, state.params, state.opt_state)
+            )
         new_state = TrainState(
             step=state.step + 1, params=new_params,
             opt_state=new_opt_state, rng=next_rng,
         )
-        metrics = dict(metrics)
-        metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
     return step
@@ -242,6 +273,7 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     stochastic: bool = False,
     accum_steps: int = 1,
+    skip_nonfinite: bool = False,
 ):
     """Build ``step(state, batch) -> (state, metrics)``, jit-compiled.
 
@@ -261,10 +293,14 @@ def make_train_step(
     equals the full-batch gradient exactly; scalar metrics are averaged
     the same way.  The micro-batch loop is a ``lax.scan``, so the model
     compiles once regardless of ``accum_steps``.
+
+    ``skip_nonfinite`` gates the optimizer update on finite loss/grads
+    (non-finite step quarantine — see :func:`_build_step_fn`).
     """
     step = _build_step_fn(
         loss_fn, optimizer, logical_axes=logical_axes, rules=rules,
         mesh=mesh, stochastic=stochastic, accum_steps=accum_steps,
+        skip_nonfinite=skip_nonfinite,
     )
     return jax.jit(step, donate_argnums=0)
 
@@ -279,6 +315,7 @@ def make_multi_step(
     mesh: Optional[Mesh] = None,
     stochastic: bool = False,
     accum_steps: int = 1,
+    skip_nonfinite: bool = False,
 ):
     """Fuse ``steps_per_dispatch`` train steps into ONE jit dispatch.
 
@@ -319,6 +356,7 @@ def make_multi_step(
     step = _build_step_fn(
         loss_fn, optimizer, logical_axes=logical_axes, rules=rules,
         mesh=mesh, stochastic=stochastic, accum_steps=accum_steps,
+        skip_nonfinite=skip_nonfinite,
     )
 
     def multi_step(

@@ -298,6 +298,14 @@ class Trainer:
       accum_steps: gradient accumulation — each train step splits its
         batch into this many micro-batches and applies ONE optimizer
         update with the mean gradient (train.make_train_step docstring).
+      nonfinite_guard: build the step functions with the on-device
+        non-finite quarantine (``train._build_step_fn`` docstring): a
+        step whose loss/grads go NaN/Inf skips its optimizer update on
+        device (params and opt_state pass through, the step counter
+        still advances) and reports ``metrics["nonfinite"]``.  fit()
+        counts skips (``train/nonfinite_skips``) and — with
+        ``rollback_after_nonfinite`` — rolls a persistently diverged run
+        back to its last verified checkpoint before stopping.
     """
 
     def __init__(
@@ -311,6 +319,7 @@ class Trainer:
         rules: ShardingRules = DEFAULT_RULES,
         stochastic: bool = False,
         accum_steps: int = 1,
+        nonfinite_guard: bool = False,
     ):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -320,15 +329,26 @@ class Trainer:
         self.rules = rules
         self.stochastic = stochastic
         self.accum_steps = accum_steps
+        self.nonfinite_guard = nonfinite_guard
         self.state: Optional[train_lib.TrainState] = None
         self.stop_training = False
         #: True when the last fit() ended by preemption drain (the
         #: process-wide stop event, ``training.preemption``) rather than
         #: data exhaustion or a callback stop.
         self.drained = False
+        #: The exactly-once data position, updated at every CONSUMPTION
+        #: boundary (a batch counts as consumed only once its state
+        #: update dispatched — prefetched-but-unconsumed batches are not
+        #: marked done).  ``CheckpointCallback`` saves this alongside the
+        #: TrainState; a restore sets ``_resume_data_state`` and the next
+        #: fit() fast-forwards the dataset to match.
+        self.data_state: Dict[str, int] = {"epoch": 0, "batches_consumed": 0}
+        self._resume_data_state: Optional[Dict[str, int]] = None
+        self._data_seed: Optional[int] = None
         self._train_step = train_lib.make_train_step(
             loss_fn, optimizer, logical_axes=logical_axes, rules=rules,
             mesh=mesh, stochastic=stochastic, accum_steps=accum_steps,
+            skip_nonfinite=nonfinite_guard,
         )
         self._eval_step = train_lib.make_eval_step(loss_fn)
         # Fused K-step dispatches, built lazily per K (jit caches compile
@@ -366,6 +386,180 @@ class Trainer:
         self.stop_training = True
         return True
 
+    @staticmethod
+    def _dataset_epoch(train_data, default: int) -> int:
+        """The dataset-ABSOLUTE epoch its next iterator will use
+        (``state_dict()['epoch']``), or ``default`` for datasets without
+        resume hooks.  The saved position records absolute epochs: a
+        dataset instance that was already iterated before this fit (a
+        warmup fit on the same instance) has its shuffle order keyed by
+        its own counter, not by this fit's epoch index — recording the
+        fit-relative index would silently replay different batches after
+        a restart."""
+        fn = getattr(train_data, "state_dict", None)
+        if fn is None:
+            return default
+        try:
+            return int(fn().get("epoch", default))
+        except Exception:  # noqa: BLE001 — positions degrade, fits don't
+            logger.debug("dataset state_dict() failed", exc_info=True)
+            return default
+
+    @staticmethod
+    def _dataset_seed(train_data):
+        """The dataset's shuffle seed (``state_dict()['seed']``), or None
+        for datasets without resume hooks.  Saved with the position: an
+        epoch/batch index only names the right batches under the shuffle
+        order it was recorded in, so a restarted script constructed with
+        a different seed must be told (and the dataset's
+        ``load_state_dict`` adopts the saved seed, loudly)."""
+        fn = getattr(train_data, "state_dict", None)
+        if fn is None:
+            return None
+        try:
+            seed = fn().get("seed")
+            return None if seed is None else int(seed)
+        except Exception:  # noqa: BLE001 — positions degrade, fits don't
+            logger.debug("dataset state_dict() failed", exc_info=True)
+            return None
+
+    def _position(self, epoch: int, consumed: int) -> Dict[str, int]:
+        """A data_state dict: position plus (when known) the shuffle seed
+        the position is valid under."""
+        pos = {"epoch": int(epoch), "batches_consumed": int(consumed)}
+        if self._data_seed is not None:
+            pos["seed"] = self._data_seed
+        return pos
+
+    def _apply_data_resume(self, train_data, base_epoch: int) -> "tuple":
+        """Consume a restored iterator state (set by a checkpoint resume
+        with ``resume_data=True``): fast-forward the dataset and return
+        ``(start_epoch, resume_skip)`` for the epoch loop.  The saved
+        epoch is dataset-absolute; ``base_epoch`` (the dataset's counter
+        at this fit's start — identical to the crashed run's, since the
+        restarted script replayed the same pre-fit history) converts it
+        back to this fit's budget position.  A dataset without
+        ``load_state_dict`` logs and restarts its stream — the legacy
+        behavior, never an error."""
+        resume = self._resume_data_state
+        self._resume_data_state = None
+        if not resume:
+            return 0, 0
+        loader = getattr(train_data, "load_state_dict", None)
+        if loader is None:
+            logger.warning(
+                "checkpoint carried iterator state %s but the dataset has "
+                "no load_state_dict(); the data stream restarts from "
+                "scratch (exactly-once resume needs a resumable dataset)",
+                resume,
+            )
+            return 0, 0
+        try:
+            loader(dict(resume))
+            abs_epoch = int(resume.get("epoch", 0))
+            start_epoch = abs_epoch - base_epoch
+            if start_epoch < 0:
+                logger.warning(
+                    "restored iterator state %s is behind the dataset's "
+                    "current epoch %d; clamping to this fit's first epoch",
+                    resume, base_epoch,
+                )
+                start_epoch = 0
+            resume_skip = int(resume.get("batches_consumed", 0))
+        except Exception:  # noqa: BLE001 — a broken fast-forward must
+            # degrade to a fresh stream, not kill the recovered job.
+            logger.exception(
+                "could not fast-forward dataset to %s; the data stream "
+                "restarts from scratch", resume,
+            )
+            return 0, 0
+        logger.info(
+            "resuming data stream at epoch %d, batch %d (exactly-once)",
+            abs_epoch, resume_skip,
+        )
+        return start_epoch, resume_skip
+
+    def _nonfinite_check(self, metrics, n_steps: int, step: int,
+                         rollback_after: Optional[int], callbacks) -> bool:
+        """Count on-device non-finite skips; roll back or stop on a
+        persistent streak.  Returns True when a rollback replaced
+        ``self.state`` (the caller re-reads its step counter).
+
+        Costs one host sync per dispatch window — only when the Trainer
+        was built with ``nonfinite_guard=True`` (same cost class as
+        ``TerminateOnNaN``'s default every-step check).
+
+        Also marks the window (``self._window_nonfinite``) so the epoch
+        accumulator can exclude it: the guard keeps NaN out of the
+        *state*, but the window's loss/grad metrics ARE NaN, and one
+        poisoned window folded into the running sums would turn the
+        whole epoch's logged means NaN — breaking exactly the
+        monitoring (History, early-stop-on-loss) the quarantine exists
+        to preserve.
+        """
+        self._window_nonfinite = False
+        if not self.nonfinite_guard:
+            return False
+        flag = metrics.get("nonfinite")
+        if flag is None:
+            return False
+        frac = float(flag)  # host sync — the guard's price
+        if frac <= 0.0:
+            self._nonfinite_streak = 0
+            return False
+        self._window_nonfinite = True
+        from cloud_tpu.monitoring import metrics as metrics_lib
+
+        skipped = max(1, int(round(frac * n_steps)))
+        metrics_lib.counter_inc("train/nonfinite_skips", skipped)
+        now = time.perf_counter()
+        tracing.record_span("train/nonfinite_skip", now, now, step=step,
+                            skipped=skipped)
+        self._nonfinite_streak += 1
+        logger.warning(
+            "non-finite metrics at step %d: %d state update(s) skipped on "
+            "device (consecutive bad windows: %d)",
+            step, skipped, self._nonfinite_streak,
+        )
+        if not rollback_after or self._nonfinite_streak < rollback_after:
+            return False
+        if self._fit_rollbacks >= 1:
+            logger.error(
+                "non-finite streak persists after a rollback; stopping "
+                "training at step %d", step,
+            )
+            self.stop_training = True
+            return False
+        provider = next(
+            (cb for cb in callbacks if hasattr(cb, "rollback_state")), None
+        )
+        rolled = False
+        if provider is not None:
+            try:
+                rolled = bool(provider.rollback_state(self))
+            except Exception:  # noqa: BLE001 — fall through to terminate
+                logger.exception("rollback to last checkpoint failed")
+        if not rolled:
+            logger.error(
+                "%d consecutive non-finite windows and no checkpoint to "
+                "roll back to; stopping training at step %d",
+                self._nonfinite_streak, step,
+            )
+            self.stop_training = True
+            return False
+        self._fit_rollbacks += 1
+        self._nonfinite_streak = 0
+        metrics_lib.counter_inc("train/rollbacks")
+        now = time.perf_counter()
+        tracing.record_span("train/rollback", now, now, from_step=step,
+                            to_step=int(self.state.step))
+        logger.warning(
+            "rolled back from step %d to verified checkpoint step %d after "
+            "%d consecutive non-finite windows; continuing on fresh data",
+            step, int(self.state.step), rollback_after,
+        )
+        return True
+
     def _multi_step_for(self, steps_per_dispatch: int):
         fn = self._multi_steps.get(steps_per_dispatch)
         if fn is None:
@@ -375,6 +569,7 @@ class Trainer:
                 logical_axes=self.logical_axes, rules=self.rules,
                 mesh=self.mesh, stochastic=self.stochastic,
                 accum_steps=self.accum_steps,
+                skip_nonfinite=self.nonfinite_guard,
             )
             self._multi_steps[steps_per_dispatch] = fn
         return fn
@@ -419,6 +614,7 @@ class Trainer:
         prefetch: int = 2,
         compile_ahead: bool = False,
         batch_spec=None,
+        rollback_after_nonfinite: Optional[int] = None,
     ) -> History:
         """Run the training loop.
 
@@ -458,11 +654,39 @@ class Trainer:
         slow to produce its first batch.  Executables are memoized in
         ``compile_cache``'s AOT registry, and a failure to compile ahead
         degrades to normal jit dispatch — never an error.
+
+        ``rollback_after_nonfinite=K`` (requires a Trainer built with
+        ``nonfinite_guard=True``) arms the divergence escape hatch: after
+        K CONSECUTIVE dispatch windows whose on-device guard skipped a
+        non-finite update, the trainer asks its checkpoint callback to
+        roll ``state`` back to the last verified checkpoint
+        (``train/rollbacks``) and continues on fresh data; a second
+        K-streak — or no callback able to roll back — stops training
+        (the existing terminate path).
+
+        Exactly-once resume: when a checkpoint restore handed back a
+        saved iterator state (``CheckpointCallback(resume_data=True)``),
+        fit fast-forwards ``train_data`` via its ``load_state_dict`` to
+        the restored epoch/batch position and continues the ORIGINAL
+        epochs budget from there — together with the restored rng chain,
+        the trajectory is bit-exactly the uninterrupted run's.
         """
         if steps_per_dispatch < 1:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}"
             )
+        if rollback_after_nonfinite is not None:
+            if rollback_after_nonfinite < 1:
+                raise ValueError(
+                    "rollback_after_nonfinite must be >= 1, got "
+                    f"{rollback_after_nonfinite}"
+                )
+            if not self.nonfinite_guard:
+                raise ValueError(
+                    "rollback_after_nonfinite needs a Trainer built with "
+                    "nonfinite_guard=True (the on-device skip supplies the "
+                    "signal the rollback trigger counts)"
+                )
         # Env-gated persistent executable cache (CLOUD_TPU_COMPILE_CACHE):
         # a once-per-process probe + enable, a cheap no-op when unset.
         compile_cache.maybe_enable_persistent_cache()
@@ -475,34 +699,68 @@ class Trainer:
         history = History()
         callbacks.append(history)
         self.stop_training = False
+        self.drained = False
+        self._nonfinite_streak = 0
+        self._window_nonfinite = False
+        self._fit_rollbacks = 0
+
+        # on_train_begin runs BEFORE the data pipeline is wired: a
+        # CheckpointCallback restore may replace self.state AND hand back
+        # the checkpoint's iterator state, which must fast-forward the
+        # dataset before any wrapper (or compile-ahead peek) pulls from it.
+        for cb in callbacks:
+            cb.on_train_begin(self)
 
         k = steps_per_dispatch
-        if k == 1:
-            source = train_data
-            if prefetch > 0 and not pipeline_io.is_prefetched(train_data):
-                source = pipeline_io.prefetch_to_device(
-                    train_data, mesh=self.mesh, rules=self.rules,
-                    size=prefetch, limit=steps_per_epoch,
-                )
-            multi_step = None
-        else:
-            if pipeline_io.is_prefetched(train_data):
-                raise ValueError(
-                    "steps_per_dispatch > 1 stacks HOST batches into a "
-                    "super-batch; pass the unwrapped dataset (fit prefetches "
-                    "whole windows itself)"
-                )
+        # The dataset's epoch counter at fit start: saved positions are
+        # recorded dataset-ABSOLUTE (base + fit-relative), so a restart
+        # that replays the same pre-fit history (a warmup fit on the
+        # same instance) fast-forwards to the right shuffle order.
+        base_epoch = self._dataset_epoch(train_data, 0)
+        start_epoch, resume_skip = self._apply_data_resume(
+            train_data, base_epoch,
+        )
+        # Read AFTER the resume: load_state_dict may have adopted the
+        # checkpoint's seed, and that adopted seed is what positions
+        # saved from this fit are valid under.
+        self._data_seed = self._dataset_seed(train_data)
+        self.data_state = self._position(base_epoch + start_epoch,
+                                         resume_skip)
+
+        if k > 1 and pipeline_io.is_prefetched(train_data):
+            raise ValueError(
+                "steps_per_dispatch > 1 stacks HOST batches into a "
+                "super-batch; pass the unwrapped dataset (fit prefetches "
+                "whole windows itself)"
+            )
+
+        def build_source(limit):
+            if k == 1:
+                if prefetch > 0 and not pipeline_io.is_prefetched(train_data):
+                    return pipeline_io.prefetch_to_device(
+                        train_data, mesh=self.mesh, rules=self.rules,
+                        size=prefetch, limit=limit,
+                    )
+                return train_data
             if prefetch > 0:
-                source = pipeline_io.prefetch_windows(
+                return pipeline_io.prefetch_windows(
                     train_data, k, mesh=self.mesh, rules=self.rules,
-                    size=prefetch, limit=steps_per_epoch,
+                    size=prefetch, limit=limit,
                 )
-            else:
-                source = pipeline_io.iter_windows(
-                    train_data, k, mesh=self.mesh, rules=self.rules,
-                    limit=steps_per_epoch,
-                )
-            multi_step = self._multi_step_for(k)
+            return pipeline_io.iter_windows(
+                train_data, k, mesh=self.mesh, rules=self.rules, limit=limit,
+            )
+
+        source = build_source(steps_per_epoch)
+        # A mid-epoch resume epoch has a smaller remaining step budget:
+        # its (one-shot) source must cap at what the interrupted epoch
+        # has left, or the fused/prefetched pipelines would pull batches
+        # the uninterrupted run never saw in that epoch.
+        if resume_skip and steps_per_epoch is not None:
+            first_source = build_source(max(steps_per_epoch - resume_skip, 0))
+        else:
+            first_source = source
+        multi_step = self._multi_step_for(k) if k > 1 else None
 
         # Compile-ahead: spawn the background compile (against avals from
         # batch_spec or a peeked first batch) BEFORE the epoch loop, so it
@@ -512,9 +770,14 @@ class Trainer:
         eval_step = None
         aot_plan = None
         peeked_iter = None
+        # Captured BEFORE the compile-ahead peek creates the first
+        # epoch's iterator (which advances the dataset's counter).
+        peeked_abs_epoch = self._dataset_epoch(
+            train_data, base_epoch + start_epoch,
+        )
         if compile_ahead:
             aot_plan, peeked_iter = self._launch_compile_ahead(
-                k, source, batch_spec,
+                k, first_source, batch_spec,
                 validation_data=validation_data,
                 multi_step=multi_step,
             )
@@ -525,16 +788,13 @@ class Trainer:
                     multi_step = aot_plan.steps["multi_step"]
                 eval_step = aot_plan.steps.get("eval_step")
 
-        for cb in callbacks:
-            cb.on_train_begin(self)
-        self.drained = False
         step = int(self.state.step)
         # The first DISPATCH of this fit() is where jit compilation happens
         # (host-side, synchronous): span it separately so compile cost is
         # attributable, and let a pending run() submit mark publish the
         # run/submit_to_first_step_seconds composite gauge.
         first_dispatch = True
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             if self.stop_training:
                 break
             for cb in callbacks:
@@ -546,14 +806,27 @@ class Trainer:
             epoch_steps = 0
             epoch_start = time.perf_counter()
             if peeked_iter is not None:
-                # Epoch 0 with compile-ahead: the avals peek already
+                # First epoch with compile-ahead: the avals peek already
                 # started this epoch's iterator (prefetch warm underneath).
                 data_iter, peeked_iter = peeked_iter, None
+                abs_epoch = peeked_abs_epoch
             else:
-                data_iter = iter(source())
+                # Dataset-absolute epoch of the iterator about to be
+                # created (read before __call__ advances the counter):
+                # this is what the saved position records, so a restart
+                # whose dataset was pre-advanced (warmup fit) still
+                # fast-forwards to the identical shuffle order.
+                abs_epoch = self._dataset_epoch(train_data, epoch)
+                data_iter = iter(
+                    (first_source if epoch == start_epoch else source)()
+                )
+            # A resumed first epoch starts mid-stream: the consumed-batch
+            # counter picks up at the restored position (the dataset's
+            # fast-forward already skipped those batches).
+            epoch_consumed = resume_skip if epoch == start_epoch else 0
             try:
                 if k == 1:
-                    i = 0
+                    i = epoch_consumed
                     while steps_per_epoch is None or i < steps_per_epoch:
                         with tracing.span("step/data"):
                             # Chaos seam: an injected plan can fail/hang
@@ -587,12 +860,24 @@ class Trainer:
                             tracing.record_submit_to_first_step()
                         step += 1
                         i += 1
+                        # Consumed = state update dispatched: prefetched
+                        # batches the device never saw stay un-consumed.
+                        self.data_state = self._position(abs_epoch, i)
+                        if self._nonfinite_check(
+                            metrics, 1, step, rollback_after_nonfinite,
+                            callbacks,
+                        ):
+                            step = int(self.state.step)
                         # Metrics stay on device: forcing float() here would
                         # block async dispatch and serialize host and TPU
                         # every step.  Callbacks get the device arrays and
                         # pay the sync only if they materialize them.
-                        self._accumulate(epoch_sums, metrics, 1)
-                        epoch_steps += 1
+                        # A quarantined window's NaN metrics are excluded
+                        # from the epoch sums (one bad batch must not turn
+                        # the whole epoch's logged means NaN).
+                        if not self._window_nonfinite:
+                            self._accumulate(epoch_sums, metrics, 1)
+                            epoch_steps += 1
                         with tracing.span("step/callbacks"):
                             for cb in callbacks:
                                 cb.on_step_end(step, metrics, self)
@@ -653,8 +938,20 @@ class Trainer:
                             first_dispatch = False
                             tracing.record_submit_to_first_step()
                         step += n
-                        self._accumulate(epoch_sums, metrics, n)
-                        epoch_steps += n
+                        epoch_consumed += n
+                        self.data_state = self._position(
+                            abs_epoch, epoch_consumed,
+                        )
+                        if self._nonfinite_check(
+                            metrics, n, step, rollback_after_nonfinite,
+                            callbacks,
+                        ):
+                            step = int(self.state.step)
+                        # A quarantined window's on-device mean is already
+                        # NaN-poisoned: exclude it from the epoch sums.
+                        if not self._window_nonfinite:
+                            self._accumulate(epoch_sums, metrics, n)
+                            epoch_steps += n
                         with tracing.span("step/callbacks"):
                             for cb in callbacks:
                                 cb.on_step_end(step, metrics, self)
@@ -668,6 +965,12 @@ class Trainer:
                 close = getattr(data_iter, "close", None)
                 if close is not None:
                     close()
+            if not self.stop_training:
+                # The epoch ran to its boundary (exhaustion or the
+                # steps_per_epoch budget): the resume position rolls over
+                # to the next epoch's start.  An early stop (drain, NaN
+                # terminate) keeps the mid-epoch position instead.
+                self.data_state = self._position(abs_epoch + 1, 0)
             epoch_host = jax.device_get(epoch_sums)
             logs = {
                 k_: float(np.mean(v) / max(epoch_steps, 1))
@@ -683,6 +986,11 @@ class Trainer:
                 logs.update({f"val_{k_}": v for k_, v in val.items()})
             for cb in callbacks:
                 cb.on_epoch_end(epoch, logs, self)
+        if peeked_iter is not None:
+            # The epoch loop never ran (a resumed position past the epochs
+            # budget): the compile-ahead peek's iterator still owns a
+            # prefetch worker that must be joined, not leaked.
+            peeked_iter.close()
         for cb in callbacks:
             cb.on_train_end(self)
         return history

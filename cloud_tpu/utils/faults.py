@@ -5,8 +5,8 @@ Fault tolerance that is only exercised by real outages is folklore; the
 lineage this repo reproduces treats partial failure as a first-class
 design axis (TensorFlow, arXiv:1605.08695 §4.3) and the serving
 comparisons it targets measure tail behavior *under* faults.  So the
-seams where reality bites — an API request, a checkpoint save, a data
-iterator pull, a device dispatch — each carry a named
+seams where reality bites — an API request, a checkpoint save / commit
+/ verify, a data iterator pull, a device dispatch — each carry a named
 :func:`fault_point`, and a test (or an operator on a staging rig)
 activates a :class:`FaultPlan` against those names:
 

@@ -1,0 +1,322 @@
+"""End-to-end durable-resume check on CPU: kill -9, corrupt, walk back.
+
+The durable-resume contracts (docs/robustness.md "Durable resume") are
+only real if a hard-crash run proves them, so this harness drives the
+full composition — the robustness analogue of ``check_chaos.py``, but
+for the checkpoint lineage + exactly-once data path:
+
+1. **control** — an uninterrupted stochastic run (shuffled data, dropout
+   rng chain) records its per-step losses and final-params digest.
+2. **crash** — the same run with ``CheckpointCallback(resume_data=True)``
+   is ``kill -9``'d mid-fit (a hard crash, not PR 6's graceful SIGTERM
+   drain): no drain save, no manifest finalize for the newest step.
+3. **corrupt** — the parent then garbles the newest (uncommitted) step
+   dir entirely and flips ONE byte in the newest *manifested* step, so
+   the restart must survive BOTH failure shapes: a partial write that
+   fails restore, and bit rot the manifest checksum alone can catch.
+4. **resume** — a fresh process re-runs the same script.  The walk-back
+   restore must quarantine both damaged steps, land on the older intact
+   checkpoint, fast-forward the data stream to its recorded position,
+   and finish with per-step losses and final params IDENTICAL to the
+   control run — zero duplicated, zero skipped batches, bit-exact rng.
+
+Prints one JSON line per phase plus a summary::
+
+    {"phase": "summary", "ok": true, "resumed_step": 24, ...}
+
+Wired as a ``slow``-marked test in tests/unit/test_durability.py (same
+pattern as check_chaos/check_fleet); the fast per-piece unit tests live
+in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+# CPU by default: a correctness harness, not a perf one.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: Save cadence / crash point: saves land at 4, 8, ..., 32; the kill at
+#: step 34 leaves step 32's manifest uncommitted (it would have been
+#: finalized at the step-36 save that never happens) and steps
+#: 20/24/28 committed — max_to_keep=4 keeps exactly [20, 24, 28, 32].
+EVERY_N_STEPS = 4
+MAX_TO_KEEP = 4
+KILL_AT_STEP = 34
+EPOCHS = 3
+BATCHES_PER_EPOCH = 12
+TOTAL_STEPS = EPOCHS * BATCHES_PER_EPOCH
+
+
+def _build(ckpt_dir=None):
+    """The shared workload: stochastic (dropout-rng) MNIST-MLP over a
+    shuffled in-memory dataset — every resume axis (shuffle order, rng
+    chain, params) is load-bearing."""
+    import functools
+
+    import jax
+    import numpy as np
+    import optax
+
+    from cloud_tpu.models import mnist
+    from cloud_tpu.training import data as data_lib
+    from cloud_tpu.training.checkpoint import CheckpointCallback
+    from cloud_tpu.training.trainer import Trainer
+
+    cfg = mnist.MnistConfig(hidden_dim=16)
+
+    def noisy_loss(params, batch, *, rng=None, config=cfg):
+        images = batch["image"]
+        if rng is not None:
+            # Dropout-class noise: the rng chain shapes the GRADIENTS, so
+            # a resume only matches the control if the chain restores
+            # bit-exactly.
+            keep = jax.random.bernoulli(rng, 0.9, images.shape)
+            images = images * keep.astype(images.dtype) / 0.9
+        return mnist.loss_fn(
+            params, {"image": images, "label": batch["label"]}, config=config
+        )
+
+    trainer = Trainer(
+        noisy_loss,
+        optax.sgd(0.1),
+        init_fn=functools.partial(mnist.init, config=cfg),
+        stochastic=True,
+    )
+    trainer.init_state(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    n = BATCHES_PER_EPOCH * 4
+    dataset = data_lib.ArrayDataset(
+        {"image": rng.normal(size=(n, 784)).astype(np.float32),
+         "label": rng.integers(0, 10, n).astype(np.int64)},
+        batch_size=4, shuffle=True, seed=7,
+    )
+    callback = None
+    if ckpt_dir is not None:
+        callback = CheckpointCallback(
+            ckpt_dir, every_n_steps=EVERY_N_STEPS, max_to_keep=MAX_TO_KEEP,
+            resume_data=True,
+        )
+    return trainer, dataset, callback
+
+
+def _params_digest(state) -> str:
+    import jax
+    import numpy as np
+
+    digest = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        digest.update(np.asarray(leaf).tobytes())
+    return digest.hexdigest()
+
+
+def _run_child(mode: str, ckpt_dir: str, out_path: str) -> None:
+    """Child body for --mode control|crash|resume (one fresh process
+    each: resume must cross a real process boundary)."""
+    from cloud_tpu.training import trainer as trainer_lib
+
+    report = {"mode": mode, "losses": {}, "start_step": None}
+    trainer, dataset, callback = _build(
+        None if mode == "control" else ckpt_dir
+    )
+
+    class Recorder(trainer_lib.Callback):
+        def on_train_begin(self, tr):
+            # Runs AFTER CheckpointCallback.on_train_begin (callback
+            # order), so this is the step training actually starts from.
+            report["start_step"] = int(tr.state.step)
+
+        def on_step_end(self, step, logs, tr):
+            report["losses"][str(step)] = float(logs["loss"])
+            if mode == "crash" and step == KILL_AT_STEP:
+                # A hard preemption mid-write window: no drain, no
+                # train-end save, no manifest finalize.
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    callbacks = [callback] if callback is not None else []
+    callbacks.append(Recorder())
+    trainer.fit(dataset, epochs=EPOCHS, callbacks=callbacks)
+
+    from cloud_tpu.monitoring import metrics as metrics_lib
+
+    counters = metrics_lib.snapshot()["counters"]
+    report.update({
+        "final_step": int(trainer.state.step),
+        "params_digest": _params_digest(trainer.state),
+        "data_state": dict(trainer.data_state),
+        "fallbacks": counters.get("checkpoint/fallbacks", 0),
+        "quarantined": counters.get("checkpoint/quarantined", 0),
+    })
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(report, f)
+
+
+def _spawn(mode: str, ckpt_dir: str, out_path: str):
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mode", mode,
+         "--ckpt-dir", ckpt_dir, "--out", out_path],
+        capture_output=True, text=True, timeout=600,
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
+def _corrupt_newest(ckpt_dir: str) -> dict:
+    """Garble the newest (uncommitted) step entirely; flip one byte in
+    the newest manifested step's first entry."""
+    from cloud_tpu.training.checkpoint import MANIFEST_NAME
+
+    steps = sorted(
+        int(name) for name in os.listdir(ckpt_dir) if name.isdigit()
+    )
+    manifested = [
+        s for s in steps
+        if os.path.exists(os.path.join(ckpt_dir, str(s), MANIFEST_NAME))
+    ]
+    newest = steps[-1]
+    newest_manifested = [s for s in manifested if s != newest][-1]
+
+    garbled_files = 0
+    for root, _dirs, files in os.walk(os.path.join(ckpt_dir, str(newest))):
+        for name in files:
+            with open(os.path.join(root, name), "wb") as f:
+                f.write(b"\x00garbage\xff" * 8)
+            garbled_files += 1
+
+    with open(os.path.join(ckpt_dir, str(newest_manifested),
+                           MANIFEST_NAME), encoding="utf-8") as f:
+        manifest = json.load(f)
+    entry = sorted(manifest["entries"])[0]
+    target = os.path.join(ckpt_dir, str(newest_manifested), entry)
+    with open(target, "r+b") as f:
+        f.seek(os.path.getsize(target) // 2)
+        original = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([original[0] ^ 0xFF]))
+
+    # The restart must land on the newest UNDAMAGED committed step.
+    # (Whether the final async save got to commit before the SIGKILL is
+    # a race — both outcomes are valid lineages and both are handled.)
+    intact = [s for s in manifested if s not in (newest, newest_manifested)]
+    return {
+        "phase": "corrupt",
+        "ok": garbled_files > 0 and bool(intact),
+        "steps_on_disk": steps,
+        "manifested": manifested,
+        "garbled_step": newest,
+        "bitflipped_step": newest_manifested,
+        "expect_resume_at": intact[-1],
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mode", choices=("control", "crash", "resume"))
+    parser.add_argument("--ckpt-dir")
+    parser.add_argument("--out")
+    parser.add_argument("--tmp-dir", default="/tmp/cloud_tpu_durability")
+    args = parser.parse_args(argv)
+
+    if args.mode:
+        _run_child(args.mode, args.ckpt_dir, args.out)
+        return 0
+
+    import shutil
+
+    shutil.rmtree(args.tmp_dir, ignore_errors=True)
+    os.makedirs(args.tmp_dir, exist_ok=True)
+    ckpt_dir = os.path.join(args.tmp_dir, "ckpt")
+    start = time.perf_counter()
+    phases = []
+
+    # Phase 1: control.
+    control_out = os.path.join(args.tmp_dir, "control.json")
+    proc = _spawn("control", ckpt_dir, control_out)
+    control = json.load(open(control_out)) if proc.returncode == 0 else {}
+    phases.append({
+        "phase": "control",
+        "ok": (proc.returncode == 0
+               and control.get("final_step") == TOTAL_STEPS),
+        "final_step": control.get("final_step"),
+    })
+    print(json.dumps(phases[-1]), flush=True)
+
+    # Phase 2: hard crash (kill -9, not a drain).
+    proc = _spawn("crash", ckpt_dir, os.path.join(args.tmp_dir, "crash.json"))
+    phases.append({
+        "phase": "crash",
+        "ok": proc.returncode == -signal.SIGKILL,
+        "returncode": proc.returncode,
+    })
+    print(json.dumps(phases[-1]), flush=True)
+
+    # Phase 3: damage the lineage both ways.
+    corrupt = _corrupt_newest(ckpt_dir)
+    phases.append(corrupt)
+    print(json.dumps(corrupt), flush=True)
+
+    # Phase 4: restart — walk back, resume exactly-once, match control.
+    resume_out = os.path.join(args.tmp_dir, "resume.json")
+    proc = _spawn("resume", ckpt_dir, resume_out)
+    resume = json.load(open(resume_out)) if proc.returncode == 0 else {}
+    expect_at = corrupt["expect_resume_at"]
+    resumed_losses = resume.get("losses", {})
+    control_losses = control.get("losses", {})
+    # Exactly-once: every step the resumed run executed must reproduce
+    # the control run's loss bit-for-bit (same batch, same rng, same
+    # params), starting at exactly expect_at + 1.
+    replay_ok = (
+        bool(resumed_losses)
+        and min(int(s) for s in resumed_losses) == expect_at + 1
+        and all(control_losses.get(s) == v
+                for s, v in resumed_losses.items())
+    )
+    quarantine_dir = os.path.join(ckpt_dir, "quarantine")
+    quarantined = (sorted(os.listdir(quarantine_dir))
+                   if os.path.isdir(quarantine_dir) else [])
+    phases.append({
+        "phase": "resume",
+        "ok": (
+            proc.returncode == 0
+            and resume.get("start_step") == expect_at
+            and resume.get("final_step") == TOTAL_STEPS
+            and resume.get("params_digest") == control.get("params_digest")
+            and replay_ok
+            and resume.get("fallbacks", 0) >= 2
+            and len(quarantined) >= 2
+        ),
+        "resumed_step": resume.get("start_step"),
+        "expected_step": expect_at,
+        "final_step": resume.get("final_step"),
+        "digest_match": (
+            resume.get("params_digest") == control.get("params_digest")
+        ),
+        "replay_exact": replay_ok,
+        "fallbacks": resume.get("fallbacks"),
+        "quarantined": quarantined,
+        "stderr_tail": proc.stderr[-500:] if proc.returncode != 0 else "",
+    })
+    print(json.dumps(phases[-1]), flush=True)
+
+    ok = all(p["ok"] for p in phases)
+    print(json.dumps({
+        "phase": "summary",
+        "ok": ok,
+        "resumed_step": resume.get("start_step"),
+        "digest_match": phases[-1]["digest_match"],
+        "wall_seconds": round(time.perf_counter() - start, 3),
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,870 @@
+"""Durable-resume tests: checkpoint lineage, exactly-once data state,
+non-finite quarantine (ISSUE 9).
+
+Each contract pinned by a fast deterministic test (the end-to-end
+kill -9 composition lives in scripts/check_durability.py, wired below as
+the slow harness):
+
+* integrity manifests — every committed save carries per-file byte
+  sizes + streamed crc32 and an atomic-rename commit marker;
+  ``verify()`` answers verified/corrupt/unmanifested, with
+  ``checkpoint.verify`` and ``checkpoint.commit`` fault seams.
+* walk-back restore — ``resume_trainer_state`` quarantines corrupt or
+  partial steps and lands on the newest intact one
+  (``checkpoint/fallbacks``), instead of starting fresh while good
+  checkpoints sit on disk.
+* exactly-once data resume — datasets derive shuffle order from
+  ``(seed, epoch)`` and fast-forward via ``load_state_dict``; the
+  trainer counts consumed batches at the DISPATCH boundary (prefetched
+  ≠ consumed) and ``CheckpointCallback(resume_data=True)`` round-trips
+  the position so a resumed fit replays exactly the control run's
+  remaining batches — and rng chain — bit-exactly.
+* non-finite step quarantine — the on-device guard skips NaN/Inf
+  updates (``train/nonfinite_skips``), and K consecutive bad windows
+  roll back to the last verified checkpoint (``train/rollbacks``)
+  before the terminate path.
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from cloud_tpu.monitoring import metrics as metrics_lib, tracing
+from cloud_tpu.training import data as data_lib, preemption
+from cloud_tpu.training import trainer as trainer_lib
+from cloud_tpu.training.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointCallback,
+    CheckpointManager,
+    resume_trainer_state,
+)
+from cloud_tpu.training.trainer import Trainer
+from cloud_tpu.utils import faults
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    yield
+    faults._clear_for_tests()
+    os.environ.pop(faults.ENV_FAULT_PLAN, None)
+
+
+def _counter(name):
+    return metrics_lib.snapshot()["counters"].get(name, 0)
+
+
+def _build_mnist(ckpt_dir=None, *, every=2, resume_data=False, seed=0,
+                 shuffle=False, stochastic=False):
+    from cloud_tpu.models import mnist
+
+    cfg = mnist.MnistConfig(hidden_dim=16)
+
+    if stochastic:
+        def loss(params, batch, *, rng=None, config=cfg):
+            images = batch["image"]
+            if rng is not None:
+                keep = jax.random.bernoulli(rng, 0.9, images.shape)
+                images = images * keep.astype(images.dtype) / 0.9
+            return mnist.loss_fn(
+                params, {"image": images, "label": batch["label"]},
+                config=config,
+            )
+    else:
+        loss = functools.partial(mnist.loss_fn, config=cfg)
+
+    tr = Trainer(
+        loss, optax.sgd(0.1),
+        init_fn=functools.partial(mnist.init, config=cfg),
+        stochastic=stochastic,
+    )
+    tr.init_state(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ds = data_lib.ArrayDataset(
+        {"image": rng.normal(size=(48, 784)).astype(np.float32),
+         "label": rng.integers(0, 10, 48).astype(np.int64)},
+        batch_size=8, shuffle=shuffle, seed=seed,
+    )
+    cb = None
+    if ckpt_dir is not None:
+        cb = CheckpointCallback(ckpt_dir, every_n_steps=every,
+                                resume_data=resume_data)
+    return tr, ds, cb
+
+
+def _flip_byte(path):
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) // 2)
+        original = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([original[0] ^ 0xFF]))
+
+
+def _params_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                        jax.tree_util.tree_leaves(b.params))
+    )
+
+
+# --- manifests ------------------------------------------------------------
+
+
+class TestManifest:
+    def _saved_manager(self, tmp_path, steps=(2, 4)):
+        tr, ds, cb = _build_mnist(str(tmp_path / "ckpt"), every=2)
+        manager = cb._get()
+        for step in steps:
+            manager.save(step, tr.state)
+        manager.wait()
+        return manager
+
+    def test_committed_save_is_verified(self, tmp_path):
+        manager = self._saved_manager(tmp_path)
+        for step in (2, 4):
+            path = os.path.join(manager.directory, str(step), MANIFEST_NAME)
+            assert os.path.exists(path)
+            with open(path) as f:
+                manifest = json.load(f)
+            assert manifest["committed"] is True
+            assert manifest["entries"]  # every orbax file hashed
+            assert manager.verify(step) == "verified"
+        manager.close()
+
+    def test_bit_flip_detected(self, tmp_path):
+        manager = self._saved_manager(tmp_path)
+        with open(os.path.join(manager.directory, "4", MANIFEST_NAME)) as f:
+            entry = sorted(json.load(f)["entries"])[0]
+        _flip_byte(os.path.join(manager.directory, "4", entry))
+        assert manager.verify(4) == "corrupt"
+        assert manager.verify(2) == "verified"
+        manager.close()
+
+    def test_missing_entry_and_missing_manifest(self, tmp_path):
+        manager = self._saved_manager(tmp_path)
+        with open(os.path.join(manager.directory, "4", MANIFEST_NAME)) as f:
+            entry = sorted(json.load(f)["entries"])[0]
+        os.remove(os.path.join(manager.directory, "4", entry))
+        assert manager.verify(4) == "corrupt"
+        os.remove(os.path.join(manager.directory, "2", MANIFEST_NAME))
+        assert manager.verify(2) == "unmanifested"
+        manager.close()
+
+    def test_commit_fault_leaves_step_unmanifested(self, tmp_path):
+        """An injected crash at the commit seam must not kill the save
+        path — the step just stays uncommitted (exactly a hard kill's
+        footprint)."""
+        tr, ds, cb = _build_mnist(str(tmp_path / "ckpt"), every=2)
+        manager = cb._get()
+        plan = [{"site": "checkpoint.commit", "nth": 1}]
+        with faults.inject(plan) as active:
+            manager.save(2, tr.state)
+            manager.wait()  # commit for step 2 fires the fault
+            manager.save(4, tr.state)
+            manager.wait()
+        assert active.fired() == {"checkpoint.commit": 1}
+        assert manager.verify(2) == "unmanifested"
+        assert manager.verify(4) == "verified"
+        manager.close()
+
+    def test_failed_save_does_not_drop_previous_manifest(self, tmp_path):
+        """An orbax save failure at step N must not lose step N-1's
+        pending manifest: the next wait/close still commits it, keeping
+        the completed checkpoint verifiable."""
+        tr, ds, cb = _build_mnist(str(tmp_path / "ckpt"), every=2)
+        manager = cb._get()
+        manager.save(2, tr.state)
+
+        original = manager._manager.save
+
+        def full_disk(*args, **kwargs):
+            raise RuntimeError("disk full")
+
+        manager._manager.save = full_disk
+        with pytest.raises(RuntimeError, match="disk full"):
+            manager.save(4, tr.state)
+        manager._manager.save = original
+        manager.wait()
+        assert manager.verify(2) == "verified"
+        manager.close()
+
+    def test_verify_fault_seam_overrides_status(self, tmp_path):
+        manager = self._saved_manager(tmp_path, steps=(2,))
+        plan = [{"site": "checkpoint.verify", "mode": "corrupt",
+                 "value": "corrupt", "nth": 1}]
+        with faults.inject(plan):
+            assert manager.verify(2) == "corrupt"
+        assert manager.verify(2) == "verified"
+        manager.close()
+
+
+# --- walk-back restore ----------------------------------------------------
+
+
+class TestWalkBack:
+    def test_corrupt_newest_quarantined_and_counted(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        tr, ds, cb = _build_mnist(ckpt, every=2)
+        tr.fit(ds, epochs=1, callbacks=[cb])
+        manager = CheckpointManager(ckpt)
+        assert manager.steps() == [2, 4, 6]
+        with open(os.path.join(ckpt, "6", MANIFEST_NAME)) as f:
+            entry = sorted(json.load(f)["entries"])[0]
+        _flip_byte(os.path.join(ckpt, "6", entry))
+
+        before = _counter("checkpoint/fallbacks")
+        tr2, _, _ = _build_mnist()
+        with tracing.collecting() as collector:
+            assert resume_trainer_state(tr2, manager) is True
+        assert int(tr2.state.step) == 4
+        assert _counter("checkpoint/fallbacks") == before + 1
+        fallbacks = [e for e in collector.events()
+                     if e["name"] == "checkpoint/fallback"]
+        assert fallbacks and fallbacks[0]["args"]["reason"] == "corrupt"
+        # Quarantined out of the lineage, pruned sidecar included.
+        assert manager.steps() == [2, 4]
+        assert manager.latest_step() == 4
+        quarantined = os.listdir(os.path.join(ckpt, "quarantine"))
+        assert len(quarantined) == 1 and "step-6" in quarantined[0]
+        manager.close()
+
+    def test_partial_unmanifested_step_quarantined(self, tmp_path):
+        """A step with no commit marker that also fails restore is a
+        partial write: quarantined, walk-back continues."""
+        ckpt = str(tmp_path / "ckpt")
+        tr, ds, cb = _build_mnist(ckpt, every=2)
+        tr.fit(ds, epochs=1, callbacks=[cb])
+        step_dir = os.path.join(ckpt, "6")
+        os.remove(os.path.join(step_dir, MANIFEST_NAME))
+        for root, _dirs, files in os.walk(step_dir):
+            for name in files:
+                with open(os.path.join(root, name), "wb") as f:
+                    f.write(b"\x00partial\xff" * 4)
+
+        tr2, _, _ = _build_mnist()
+        manager = CheckpointManager(ckpt)
+        assert resume_trainer_state(tr2, manager) is True
+        assert int(tr2.state.step) == 4
+        assert not os.path.isdir(step_dir)
+        manager.close()
+
+    def test_verify_error_walks_back_and_quarantines(self, tmp_path):
+        """A verify() that RAISES (transient IO, chaos) must quarantine
+        the walked-past step like every other failure mode: left in the
+        lineage, the stale newer dir would make orbax silently skip
+        every save of the resumed run."""
+        ckpt = str(tmp_path / "ckpt")
+        tr, ds, cb = _build_mnist(ckpt, every=2)
+        tr.fit(ds, epochs=1, callbacks=[cb])
+        manager = CheckpointManager(ckpt)
+        assert manager.steps() == [2, 4, 6]
+
+        tr2, _, _ = _build_mnist()
+        plan = [{"site": "checkpoint.verify", "nth": 1}]
+        with tracing.collecting() as collector, faults.inject(plan):
+            assert resume_trainer_state(tr2, manager) is True
+        assert int(tr2.state.step) == 4
+        assert manager.steps() == [2, 4]  # step 6 left the lineage
+        quarantined = os.listdir(os.path.join(ckpt, "quarantine"))
+        assert any("step-6" in name for name in quarantined)
+        fallbacks = [e for e in collector.events()
+                     if e["name"] == "checkpoint/fallback"]
+        assert fallbacks[0]["args"]["reason"] == "verify_error"
+        manager.close()
+
+    def test_only_if_ahead_false_restores_step_zero(self, tmp_path):
+        """The cloud_fit path: a user-uploaded state saved at step 0
+        (pretrained weights) must replace the fresh init — and the
+        default only_if_ahead=True must keep skipping it."""
+        ckpt = str(tmp_path / "seed_state")
+        tr, _, _ = _build_mnist()
+        uploaded = tr.state.replace(
+            params=jax.tree_util.tree_map(lambda x: x + 1.0, tr.state.params)
+        )
+        manager = CheckpointManager(ckpt)
+        manager.save(0, uploaded)
+        manager.wait()
+
+        tr2, _, _ = _build_mnist()
+        assert resume_trainer_state(tr2, manager) is False  # not ahead
+        assert resume_trainer_state(
+            tr2, manager, only_if_ahead=False
+        ) is True
+        np.testing.assert_array_equal(
+            np.asarray(tr2.state.params["hidden"]["kernel"]),
+            np.asarray(uploaded.params["hidden"]["kernel"]),
+        )
+        manager.close()
+
+
+# --- exactly-once data resume ---------------------------------------------
+
+
+class TestDatasetResume:
+    def _dataset(self, seed=5):
+        rng = np.random.default_rng(1)
+        return data_lib.ArrayDataset(
+            {"x": rng.normal(size=(24, 3)).astype(np.float32)},
+            batch_size=4, shuffle=True, seed=seed,
+        )
+
+    def test_array_dataset_fast_forward_matches_uninterrupted(self):
+        full = self._dataset()
+        epochs = [[b["x"] for b in full()] for _ in range(3)]
+
+        resumed = self._dataset()
+        resumed.load_state_dict({"epoch": 1, "batches_consumed": 2})
+        got = [b["x"] for b in resumed()]
+        for want, have in zip(epochs[1][2:], got):
+            np.testing.assert_array_equal(want, have)
+        assert len(got) == len(epochs[1]) - 2
+        # Subsequent epochs continue the lineage with zero skip.
+        nxt = [b["x"] for b in resumed()]
+        for want, have in zip(epochs[2], nxt):
+            np.testing.assert_array_equal(want, have)
+
+    def test_epoch_orders_derived_not_chained(self):
+        """Epoch E's order is f(seed, E): reproducible without replaying
+        earlier epochs, distinct across epochs, seed-sensitive."""
+        a, b = self._dataset(), self._dataset()
+        first_a = np.concatenate([x["x"][:, 0] for x in a()])
+        _ = list(b())  # advance b one epoch
+        second_b = np.concatenate([x["x"][:, 0] for x in b()])
+        second_a = np.concatenate([x["x"][:, 0] for x in a()])
+        np.testing.assert_array_equal(second_a, second_b)
+        assert not np.array_equal(first_a, second_a)
+        other = np.concatenate([x["x"][:, 0]
+                                for x in self._dataset(seed=6)()])
+        assert not np.array_equal(first_a, other)
+
+    def test_record_dataset_fast_forward(self, tmp_path):
+        from cloud_tpu.training import records
+
+        path = str(tmp_path / "data.rec")
+        with records.RecordWriter(path) as w:
+            for i in range(32):
+                w.write(records.encode_tensor_record(
+                    {"x": np.full((2,), i, np.float32)}
+                ))
+
+        def build():
+            return records.RecordDataset(
+                path, batch_size=4, shuffle_buffer=8, seed=3,
+                shard_by_process=False,
+            )
+
+        full = build()
+        epochs = [[b["x"] for b in full()] for _ in range(2)]
+        resumed = build()
+        resumed.load_state_dict({"epoch": 1, "batches_consumed": 3})
+        got = [b["x"] for b in resumed()]
+        assert len(got) == len(epochs[1]) - 3
+        for want, have in zip(epochs[1][3:], got):
+            np.testing.assert_array_equal(want, have)
+
+    def test_prefetch_factories_forward_state_hooks(self):
+        from cloud_tpu.training import pipeline_io
+
+        ds = self._dataset()
+        wrapped = pipeline_io.prefetch_to_device(ds, size=1)
+        assert wrapped.state_dict() == ds.state_dict()
+        wrapped.load_state_dict({"epoch": 2, "batches_consumed": 1})
+        assert ds._epoch == 2 and ds._skip == 1
+
+    def test_seed_mismatch_adopts_checkpoint_seed(self, caplog):
+        """A position is only meaningful under the shuffle order it was
+        recorded in: a dataset built with a DIFFERENT seed adopts the
+        checkpoint's seed (loudly) and replays the recorded stream."""
+        import logging
+
+        recorded = self._dataset(seed=5)
+        epochs = [[b["x"] for b in recorded()] for _ in range(2)]
+
+        misbuilt = self._dataset(seed=99)
+        with caplog.at_level(logging.WARNING,
+                             logger="cloud_tpu.training.data"):
+            misbuilt.load_state_dict(
+                {"epoch": 1, "batches_consumed": 2, "seed": 5}
+            )
+        assert any("seed" in r.message for r in caplog.records)
+        got = [b["x"] for b in misbuilt()]
+        assert len(got) == len(epochs[1]) - 2
+        for want, have in zip(epochs[1][2:], got):
+            np.testing.assert_array_equal(want, have)
+
+    def test_record_no_buffer_fast_forward_skips_decode(self, tmp_path):
+        """With no shuffle buffer there is no draw state to advance, so
+        the fast-forward skips at the RECORD level: parity with the
+        uninterrupted stream AND zero decodes for skipped batches."""
+        from cloud_tpu.training import records
+
+        path = str(tmp_path / "plain.rec")
+        with records.RecordWriter(path) as w:
+            for i in range(32):
+                w.write(records.encode_tensor_record(
+                    {"x": np.full((2,), i, np.float32)}
+                ))
+
+        decodes = [0]
+
+        def counting_decode(payload):
+            decodes[0] += 1
+            return records.decode_tensor_record(payload)
+
+        def build():
+            return records.RecordDataset(
+                path, batch_size=4, shuffle_buffer=0, seed=3,
+                shard_by_process=False, decode=counting_decode,
+            )
+
+        full = build()
+        epochs = [[b["x"] for b in full()] for _ in range(2)]
+        baseline_decodes = decodes[0]
+
+        decodes[0] = 0
+        resumed = build()
+        resumed.load_state_dict(
+            {"epoch": 1, "batches_consumed": 3, "seed": 3}
+        )
+        got = [b["x"] for b in resumed()]
+        assert len(got) == len(epochs[1]) - 3
+        for want, have in zip(epochs[1][3:], got):
+            np.testing.assert_array_equal(want, have)
+        # Only the non-skipped tail was decoded (the framing of skipped
+        # records is still read, their payloads never decoded).
+        assert decodes[0] == len(got) * 4
+        assert decodes[0] < baseline_decodes
+
+
+class TestTrainerDataState:
+    def test_consumed_counted_at_dispatch_not_prefetch(self):
+        """The prefetcher pulls ahead of the device; only DISPATCHED
+        batches may count as consumed."""
+        tr, ds, _ = _build_mnist()
+        seen = []
+        spy = trainer_lib.LambdaCallback(
+            on_step_end=lambda s, logs, t: seen.append(dict(t.data_state))
+        )
+        tr.fit(ds, epochs=1, steps_per_epoch=3, prefetch=2, callbacks=[spy])
+        assert seen == [
+            {"epoch": 0, "batches_consumed": 1, "seed": 0},
+            {"epoch": 0, "batches_consumed": 2, "seed": 0},
+            {"epoch": 0, "batches_consumed": 3, "seed": 0},
+        ]
+        # The budgeted epoch completed: position rolls to the next epoch.
+        assert tr.data_state == {
+            "epoch": 1, "batches_consumed": 0, "seed": 0,
+        }
+
+    def test_checkpoint_carries_data_state(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        tr, ds, cb = _build_mnist(ckpt, every=2)
+        tr.fit(ds, epochs=1, callbacks=[cb])
+        manager = CheckpointManager(ckpt)
+        # The composite carries position AND the shuffle seed it is
+        # valid under (a restart built with another seed adopts this
+        # one — see TestDatasetResume).
+        assert manager.read_extras(4) == {
+            "data_state": {"epoch": 0, "batches_consumed": 4, "seed": 0},
+        }
+        manager.close()
+
+    def test_drain_resume_is_exactly_once_and_bit_exact(self, tmp_path):
+        """The acceptance composition, in-process: stop mid-epoch, save,
+        restart with resume_data=True — the remaining batches (shuffled
+        order included) and the rng chain replay bit-exactly, so the
+        final params equal the uninterrupted control run's."""
+        control, ds, _ = _build_mnist(shuffle=True, seed=3, stochastic=True)
+        control_losses = {}
+        spy = trainer_lib.LambdaCallback(
+            on_step_end=lambda s, logs, t:
+                control_losses.update({s: float(logs["loss"])})
+        )
+        control.fit(ds, epochs=2, callbacks=[spy])
+        assert int(control.state.step) == 12
+
+        ckpt = str(tmp_path / "drain")
+        preemption._reset_for_tests()
+        try:
+            tr1, ds1, cb1 = _build_mnist(
+                ckpt, every=100, resume_data=True, shuffle=True, seed=3,
+                stochastic=True,
+            )
+            stopper = trainer_lib.LambdaCallback(
+                on_step_end=lambda s, logs, t:
+                    preemption.request_stop("test") if s == 3 else None
+            )
+            tr1.fit(ds1, epochs=2, callbacks=[cb1, stopper])
+            assert tr1.drained and int(tr1.state.step) == 3
+            assert tr1.data_state == {
+                "epoch": 0, "batches_consumed": 3, "seed": 3,
+            }
+        finally:
+            preemption._reset_for_tests()
+
+        tr2, ds2, cb2 = _build_mnist(
+            ckpt, every=100, resume_data=True, shuffle=True, seed=3,
+            stochastic=True,
+        )
+        resumed_losses = {}
+        spy2 = trainer_lib.LambdaCallback(
+            on_step_end=lambda s, logs, t:
+                resumed_losses.update({s: float(logs["loss"])})
+        )
+        tr2.fit(ds2, epochs=2, callbacks=[cb2, spy2])
+        assert min(resumed_losses) == 4   # no replayed, no skipped steps
+        assert int(tr2.state.step) == 12  # the ORIGINAL budget, not +2 epochs
+        assert all(control_losses[s] == v for s, v in resumed_losses.items())
+        assert _params_equal(control.state, tr2.state)
+
+    def test_warmup_fit_resume_uses_absolute_dataset_epoch(self, tmp_path):
+        """A dataset instance already iterated BEFORE the checkpointed
+        fit (a warmup fit on the same object) keys its shuffle order off
+        its own epoch counter: the saved position must be
+        dataset-absolute, so a restart that replays the same warmup
+        fast-forwards to the identical stream (fit-relative epochs would
+        silently replay a different shuffle order)."""
+        def build():
+            tr, ds, _ = _build_mnist(shuffle=True, seed=3, stochastic=True)
+            tr.fit(ds, epochs=1)  # warmup: ds epoch counter now at 1
+            return tr, ds
+
+        control, control_ds = build()
+        control.fit(control_ds, epochs=2)
+        assert int(control.state.step) == 18
+
+        ckpt = str(tmp_path / "warmup")
+        preemption._reset_for_tests()
+        try:
+            tr1, ds1 = build()
+            cb1 = CheckpointCallback(ckpt, every_n_steps=100,
+                                     resume_data=True)
+            stopper = trainer_lib.LambdaCallback(
+                on_step_end=lambda s, logs, t:
+                    preemption.request_stop("test") if s == 9 else None
+            )
+            tr1.fit(ds1, epochs=2, callbacks=[cb1, stopper])
+            assert tr1.drained and int(tr1.state.step) == 9
+            # Dataset-ABSOLUTE epoch (warmup consumed epoch 0).
+            assert tr1.data_state == {
+                "epoch": 1, "batches_consumed": 3, "seed": 3,
+            }
+        finally:
+            preemption._reset_for_tests()
+
+        tr2, ds2 = build()
+        cb2 = CheckpointCallback(ckpt, every_n_steps=100, resume_data=True)
+        tr2.fit(ds2, epochs=2, callbacks=[cb2])
+        assert int(tr2.state.step) == 18
+        assert _params_equal(control.state, tr2.state)
+
+    def test_resume_without_hooks_warns_and_restarts_stream(
+        self, tmp_path, caplog
+    ):
+        import logging
+
+        ckpt = str(tmp_path / "nohooks")
+        tr, ds, cb = _build_mnist(ckpt, every=2, resume_data=True)
+        tr.fit(ds, epochs=1, callbacks=[cb])
+
+        def plain_dataset():  # no state hooks: the legacy contract
+            rng = np.random.default_rng(0)
+            for _ in range(6):
+                yield {"image": rng.normal(size=(8, 784)).astype(np.float32),
+                       "label": rng.integers(0, 10, 8).astype(np.int64)}
+
+        tr2, _, cb2 = _build_mnist(ckpt, every=2, resume_data=True)
+        with caplog.at_level(logging.WARNING):
+            tr2.fit(plain_dataset, epochs=1, callbacks=[cb2])
+        assert "no load_state_dict" in caplog.text
+        assert int(tr2.state.step) == 12  # resumed params, fresh stream
+
+
+# --- non-finite step quarantine -------------------------------------------
+
+
+def _linear_fixture(poison_slice=None):
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, {"loss": loss}
+
+    def init_fn(rng):
+        return {"w": jax.random.normal(rng, (4, 2)) * 0.1}
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(24, 4)).astype(np.float32)
+    y = rng.normal(size=(24, 2)).astype(np.float32)
+    if poison_slice is not None:
+        x[poison_slice] = np.nan
+    ds = data_lib.ArrayDataset({"x": x, "y": y}, batch_size=4)
+    return loss_fn, init_fn, ds
+
+
+class TestNonfiniteGuard:
+    def test_poisoned_step_skipped_on_device(self):
+        """One NaN batch: the update is skipped (params match a run that
+        never saw the batch), the step counter still advances, and the
+        skip is counted + spanned."""
+        loss_fn, init_fn, ds = _linear_fixture(poison_slice=slice(8, 12))
+        guarded = Trainer(loss_fn, optax.sgd(0.01), init_fn=init_fn,
+                          nonfinite_guard=True)
+        guarded.init_state(jax.random.PRNGKey(1))
+        before = _counter("train/nonfinite_skips")
+        with tracing.collecting() as collector:
+            guarded.fit(ds, epochs=1)
+        assert _counter("train/nonfinite_skips") == before + 1
+        assert int(guarded.state.step) == 6  # batch consumed, step advanced
+        assert np.isfinite(np.asarray(guarded.state.params["w"])).all()
+        spans = [e for e in collector.events()
+                 if e["name"] == "train/nonfinite_skip"]
+        assert len(spans) == 1 and spans[0]["args"]["step"] == 3
+
+        # Reference: the same trajectory with the poisoned batch's update
+        # simply absent — what "skip" must mean.
+        loss_fn2, init_fn2, _ = _linear_fixture()
+        reference = Trainer(loss_fn2, optax.sgd(0.01), init_fn=init_fn2)
+        reference.init_state(jax.random.PRNGKey(1))
+        _, _, clean_ds = _linear_fixture()
+        keep = np.concatenate([np.arange(0, 8), np.arange(12, 24)])
+        pruned = data_lib.ArrayDataset(
+            {"x": clean_ds.arrays["x"][keep], "y": clean_ds.arrays["y"][keep]},
+            batch_size=4,
+        )
+        reference.fit(pruned, epochs=1)
+        np.testing.assert_allclose(
+            np.asarray(guarded.state.params["w"]),
+            np.asarray(reference.state.params["w"]), atol=1e-7,
+        )
+
+    def test_unguarded_trainer_rejects_rollback_arg(self):
+        loss_fn, init_fn, ds = _linear_fixture()
+        tr = Trainer(loss_fn, optax.sgd(0.01), init_fn=init_fn)
+        tr.init_state(jax.random.PRNGKey(1))
+        with pytest.raises(ValueError, match="nonfinite_guard"):
+            tr.fit(ds, epochs=1, rollback_after_nonfinite=2)
+
+    def test_streak_rolls_back_then_terminates(self, tmp_path):
+        """K consecutive bad windows: roll back to the last verified
+        checkpoint, continue; a second streak stops training."""
+        loss_fn, init_fn, ds = _linear_fixture(poison_slice=slice(8, None))
+        tr = Trainer(loss_fn, optax.sgd(0.01), init_fn=init_fn,
+                     nonfinite_guard=True)
+        tr.init_state(jax.random.PRNGKey(1))
+        ckpt = str(tmp_path / "rollback")
+        cb = CheckpointCallback(ckpt, every_n_steps=2)
+        before = _counter("train/rollbacks")
+        with tracing.collecting() as collector:
+            tr.fit(ds, epochs=2, callbacks=[cb],
+                   rollback_after_nonfinite=2)
+        assert _counter("train/rollbacks") == before + 1
+        assert tr.stop_training is True  # second streak terminated
+        rollbacks = [e for e in collector.events()
+                     if e["name"] == "train/rollback"]
+        assert len(rollbacks) == 1
+        assert rollbacks[0]["args"]["to_step"] == 2
+        # The rolled-back params are the step-2 checkpoint's, not NaN.
+        assert np.isfinite(np.asarray(tr.state.params["w"])).all()
+
+    def test_quarantined_window_excluded_from_epoch_logs(self):
+        """The guard keeps NaN out of the state; the epoch accumulator
+        must keep it out of the LOGS too — one poisoned window folded
+        into the running sums would report loss=NaN for the whole epoch
+        (breaking history/early-stop, the monitoring the quarantine
+        exists to preserve)."""
+        loss_fn, init_fn, ds = _linear_fixture(poison_slice=slice(8, 12))
+        tr = Trainer(loss_fn, optax.sgd(0.01), init_fn=init_fn,
+                     nonfinite_guard=True)
+        tr.init_state(jax.random.PRNGKey(1))
+        epoch_logs = {}
+        spy = trainer_lib.LambdaCallback(
+            on_epoch_end=lambda e, logs, t: epoch_logs.update(logs)
+        )
+        history = tr.fit(ds, epochs=1, callbacks=[spy])
+        assert np.isfinite(epoch_logs["loss"])
+        assert np.isfinite(history.history["loss"][0])
+
+    def test_streak_without_checkpoint_terminates(self):
+        loss_fn, init_fn, ds = _linear_fixture(poison_slice=slice(8, None))
+        tr = Trainer(loss_fn, optax.sgd(0.01), init_fn=init_fn,
+                     nonfinite_guard=True)
+        tr.init_state(jax.random.PRNGKey(1))
+        tr.fit(ds, epochs=2, rollback_after_nonfinite=2)
+        assert tr.stop_training is True
+        assert int(tr.state.step) == 4  # stopped at the second bad window
+
+    def test_guard_composes_with_fused_dispatch(self):
+        """K>1 windows carry the window-mean nonfinite flag; a poisoned
+        window is counted without breaking the fused path."""
+        loss_fn, init_fn, ds = _linear_fixture(poison_slice=slice(8, 12))
+        tr = Trainer(loss_fn, optax.sgd(0.01), init_fn=init_fn,
+                     nonfinite_guard=True)
+        tr.init_state(jax.random.PRNGKey(1))
+        before = _counter("train/nonfinite_skips")
+        tr.fit(ds, epochs=1, steps_per_dispatch=2)
+        assert _counter("train/nonfinite_skips") == before + 1
+        assert int(tr.state.step) == 6
+        assert np.isfinite(np.asarray(tr.state.params["w"])).all()
+
+
+# --- satellites -----------------------------------------------------------
+
+
+class TestCheckpointCallbackSatellites:
+    def test_on_train_end_without_state_logs_not_crashes(self, tmp_path,
+                                                         caplog):
+        import logging
+
+        cb = CheckpointCallback(str(tmp_path / "nostate"))
+        with caplog.at_level(logging.WARNING):
+            cb.on_train_end(types.SimpleNamespace(state=None))
+        assert "skipping final save" in caplog.text
+
+    def test_fused_dispatch_fires_on_interval_crossings(self, tmp_path):
+        """steps_per_dispatch=k reports only window-boundary steps; the
+        periodic trigger must fire on every interval CROSSING (forced
+        past orbax's modulo policy), not degrade to lcm(k, every)."""
+        ckpt = str(tmp_path / "fused")
+        tr, ds, cb = _build_mnist(ckpt, every=4)
+        tr.fit(ds, epochs=2, steps_per_dispatch=3, callbacks=[cb])
+        assert int(tr.state.step) == 12  # windows end at 3, 6, 9, 12
+        manager = CheckpointManager(ckpt)
+        # Crossings of the every=4 grid at window boundaries: 6 (past 4),
+        # 9 (past 8), 12 (on 12) — NOT only step 12 (lcm(3, 4) = 12).
+        assert manager.steps() == [6, 9, 12]
+        assert all(manager.verify(s) == "verified" for s in (6, 9, 12))
+        manager.close()
+
+    def test_train_end_save_lands_off_interval(self, tmp_path):
+        """The train-end/drain emergency save rarely lands on a multiple
+        of every_n_steps; orbax's modulo interval policy must not
+        silently skip it (that save exists to bound lost work)."""
+        ckpt = str(tmp_path / "emergency")
+        tr, ds, cb = _build_mnist(ckpt, every=4)
+        tr.fit(ds, epochs=1, callbacks=[cb])  # 6 steps; periodic save: 4
+        manager = CheckpointManager(ckpt)
+        assert manager.steps() == [4, 6]
+        assert manager.verify(6) == "verified"
+        manager.close()
+
+    def test_quarantine_gc_prunes_by_quarantine_time(self, tmp_path):
+        """shutil.move preserves the step dir's original mtime: pruning
+        by mtime would delete the JUST-quarantined dir of an old step
+        (the forensics being collected) while keeping stale entries.
+        The dst name embeds the quarantine wall-clock — prune by that."""
+        manager = CheckpointManager(str(tmp_path / "q"), max_to_keep=2)
+        qdir = os.path.join(manager.directory, "quarantine")
+        os.makedirs(qdir)
+        # Quarantine order by name-timestamp: step-2 first, step-6 last.
+        # mtimes INVERTED: the earliest-quarantined dir looks newest.
+        for name, mtime in (("step-2-1000", 300.0), ("step-4-2000", 200.0),
+                            ("step-6-3000", 100.0)):
+            path = os.path.join(qdir, name)
+            os.makedirs(path)
+            os.utime(path, (mtime, mtime))
+        manager._gc_quarantine(qdir)
+        assert sorted(os.listdir(qdir)) == ["step-4-2000", "step-6-3000"]
+        manager.close()
+
+    def test_double_save_failure_survived(self, tmp_path):
+        """Periodic save fails, the REBUILT manager's next periodic save
+        fails again: both are absorbed (two save_failures, two manager
+        rebuilds) and the train-end save still lands."""
+        ckpt = str(tmp_path / "double")
+        tr, ds, cb = _build_mnist(ckpt, every=2)
+        before = _counter("checkpoint/save_failures")
+        plan = [{"site": "checkpoint.save", "mode": "raise", "times": 2}]
+        with faults.inject(plan) as active:
+            tr.fit(ds, epochs=1, callbacks=[cb])
+        assert active.fired() == {"checkpoint.save": 2}
+        assert _counter("checkpoint/save_failures") == before + 2
+        assert int(tr.state.step) == 6  # fit unharmed
+        manager = CheckpointManager(ckpt)
+        assert manager.latest_step() == 6
+        assert manager.verify(6) == "verified"
+        manager.close()
+
+
+class TestReportDurability:
+    def _events(self):
+        def span(name, args):
+            return {"name": name, "ph": "X", "ts": 0.0, "dur": 10.0,
+                    "pid": 1, "tid": 1, "args": args}
+
+        return [
+            span("checkpoint/fallback", {"step": 6, "reason": "corrupt"}),
+            span("checkpoint/fallback",
+                 {"step": 4, "reason": "restore_failed"}),
+            span("train/nonfinite_skip", {"step": 3, "skipped": 2}),
+            span("train/rollback", {"from_step": 5, "to_step": 2}),
+            span("step/compute", {}),
+        ]
+
+    def test_summary_fields(self):
+        from cloud_tpu.monitoring.report import TraceReport
+
+        summary = TraceReport(self._events()).robustness_summary()
+        assert summary["restore_fallbacks"] == 2
+        assert summary["nonfinite"] == {"windows": 1, "steps": 2}
+        assert summary["rollbacks"] == 1
+
+    def test_render_lines(self):
+        from cloud_tpu.monitoring.report import TraceReport
+
+        rendered = TraceReport(self._events()).render()
+        assert "checkpoint restore fallbacks (walk-back): 2" in rendered
+        assert "non-finite updates skipped: 2 step(s) over 1 window(s)" \
+            in rendered
+        assert "divergence rollbacks to verified checkpoint: 1" in rendered
+
+    def test_durability_only_timeline_has_section(self):
+        from cloud_tpu.monitoring.report import TraceReport
+
+        report = TraceReport([{
+            "name": "checkpoint/fallback", "ph": "X", "ts": 0.0, "dur": 1.0,
+            "pid": 1, "tid": 1, "args": {"step": 2, "reason": "corrupt"},
+        }])
+        assert report.robustness_summary() is not None
+        assert "robustness" in report.render()
+
+
+# --- the end-to-end durability harness ------------------------------------
+
+
+@pytest.mark.slow
+def test_check_durability_script(tmp_path):
+    """scripts/check_durability.py end to end: kill -9 mid-fit plus a
+    corrupted newest checkpoint → the restart walks back to an intact
+    step, replays exactly the remaining batches, and finishes bit-equal
+    to the uninterrupted control run."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "scripts", "check_durability.py"),
+         f"--tmp-dir={tmp_path}"],
+        capture_output=True, text=True, timeout=580,
+        cwd=REPO_ROOT, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, (proc.stdout or "") + (proc.stderr or "")
+    summary = None
+    for line in proc.stdout.splitlines():
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue
+        if record.get("phase") == "summary":
+            summary = record
+    assert summary is not None, proc.stdout[-500:]
+    assert summary["ok"] is True
+    assert summary["digest_match"] is True

@@ -94,7 +94,11 @@ class TestExampleLaunchers:
         "example",
         [
             "call_run_on_script.py",
-            "call_run_on_notebook.py",
+            # Slow tier: the notebook launcher pays a full .ipynb
+            # conversion (~10s); its dry-run contract stays fast-pinned
+            # by test_notebook_dockerfile_points_at_converted_script.
+            pytest.param("call_run_on_notebook.py",
+                         marks=pytest.mark.slow),
             "call_run_with_cloud_build.py",
             "call_run_with_custom_image.py",
             "call_run_with_workers.py",
@@ -201,7 +205,12 @@ class TestExampleNotebooks:
         # The server side saved its output next to the assets.
         assert (tmp_path / "rd" / "output" / "history.json").exists()
 
+    @pytest.mark.slow
     def test_image_classification(self, monkeypatch, tmp_path):
+        # Slow tier: the heaviest notebook execution (full conv-model fit
+        # with a profiler trace window, ~30-50s on the CPU rig); the
+        # other notebook tests keep the conversion/self-launch contract
+        # in the fast tier.
         import glob
 
         mod = self._run_converted(

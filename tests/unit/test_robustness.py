@@ -19,8 +19,11 @@ below as the slow harness):
 * preemption drain — a real SIGTERM mid-fit checkpoints within one
   dispatch window and a fresh Trainer resumes from it.
 * ``training.checkpoint`` — a crashed periodic save doesn't kill the
-  fit; a corrupt latest checkpoint logs "starting fresh" and returns
-  False instead of killing the job.
+  fit; a corrupt latest checkpoint is quarantined and the restore WALKS
+  BACK to the newest intact step (ISSUE 9's durable-resume contract —
+  the full lineage/manifold/data-resume suite lives in
+  tests/unit/test_durability.py); only when no candidate survives does
+  resume log "starting fresh" and return False.
 """
 
 import functools
@@ -885,13 +888,10 @@ class TestCheckpointRobustness:
         assert active.fired() == {"checkpoint.save": 1}
         assert CheckpointManager(ckpt).latest_step() == 6
 
-    def test_corrupt_latest_checkpoint_starts_fresh(self, tmp_path,
-                                                    caplog):
-        """The resume_trainer_state failure contract: a corrupt or
-        unreadable latest checkpoint logs 'starting fresh' and returns
-        False — never kills the job at startup."""
-        import logging
-
+    def test_corrupt_latest_checkpoint_walks_back(self, tmp_path):
+        """ISSUE 9's durable-resume contract: a corrupt latest
+        checkpoint is quarantined and the restore walks back to the
+        newest INTACT step instead of throwing away all progress."""
         from cloud_tpu.training.checkpoint import (
             CheckpointCallback, CheckpointManager, resume_trainer_state,
         )
@@ -914,6 +914,41 @@ class TestCheckpointRobustness:
 
         tr2, _, _ = _build_mnist_trainer()
         assert int(tr2.state.step) == 0
+        ok = resume_trainer_state(tr2, CheckpointManager(ckpt))
+        assert ok is True
+        assert int(tr2.state.step) == 4  # the newest INTACT step
+        # The corrupt step left the lineage (quarantined, not deleted).
+        assert not os.path.isdir(step_dir)
+        assert os.path.isdir(os.path.join(ckpt, "quarantine"))
+
+        # And the callback path composes end to end: training resumes
+        # from step 4 instead of dying (or restarting) at on_train_begin.
+        cb2 = CheckpointCallback(ckpt, every_n_steps=100)
+        tr3, ds3, _ = _build_mnist_trainer()
+        tr3.fit(ds3, epochs=1, callbacks=[cb2])
+        assert int(tr3.state.step) == 10  # resumed at 4, +6 steps
+
+    def test_every_checkpoint_corrupt_starts_fresh(self, tmp_path, caplog):
+        """Only when NO candidate survives does resume keep the old
+        failure contract: log 'starting fresh', return False, never kill
+        the job at startup."""
+        import logging
+
+        from cloud_tpu.training.checkpoint import (
+            CheckpointManager, resume_trainer_state,
+        )
+
+        ckpt = str(tmp_path / "all_corrupt")
+        tr, ds, cb = _build_mnist_trainer(ckpt, every=2)
+        tr.fit(ds, epochs=1, callbacks=[cb])
+        for step in CheckpointManager(ckpt).steps():
+            step_dir = os.path.join(ckpt, str(step))
+            for root, _dirs, files in os.walk(step_dir):
+                for name in files:
+                    with open(os.path.join(root, name), "wb") as f:
+                        f.write(b"\x00corrupt\xff" * 4)
+
+        tr2, _, _ = _build_mnist_trainer()
         fresh_kernel = np.asarray(tr2.state.params["hidden"]["kernel"])
         with caplog.at_level(logging.ERROR):
             ok = resume_trainer_state(tr2, CheckpointManager(ckpt))
@@ -924,14 +959,13 @@ class TestCheckpointRobustness:
             np.asarray(tr2.state.params["hidden"]["kernel"]), fresh_kernel
         )
 
-        # And the callback path shrugs it off end to end: training runs
-        # from scratch instead of dying at on_train_begin.
-        cb2 = CheckpointCallback(ckpt, every_n_steps=100)
-        tr3, ds3, _ = _build_mnist_trainer()
-        tr3.fit(ds3, epochs=1, callbacks=[cb2])
-        assert int(tr3.state.step) == 6
-
-    def test_restore_fault_injection_returns_false(self, tmp_path):
+    def test_restore_fault_injection_falls_back(self, tmp_path):
+        """An injected restore failure on the newest step no longer
+        starts fresh: the walk-back quarantines it and lands on the
+        older intact step.  The quarantine is load-bearing — a stale
+        newer step left in the lineage would make orbax silently skip
+        every save of the resumed run (save(step) not ahead of
+        latest_step is a no-op)."""
         from cloud_tpu.training.checkpoint import (
             CheckpointManager, resume_trainer_state,
         )
@@ -941,9 +975,19 @@ class TestCheckpointRobustness:
         tr.fit(ds, epochs=1, callbacks=[cb])
         tr2, _, _ = _build_mnist_trainer()
         plan = [{"site": "checkpoint.restore", "nth": 1}]
+        manager = CheckpointManager(ckpt)
         with faults.inject(plan):
-            assert resume_trainer_state(tr2, CheckpointManager(ckpt)) is False
-        assert int(tr2.state.step) == 0
+            assert resume_trainer_state(tr2, manager) is True
+        assert int(tr2.state.step) == 3
+        assert not os.path.isdir(os.path.join(ckpt, "6"))
+        assert os.path.isdir(os.path.join(ckpt, "quarantine"))  # forensics
+        # The resumed run's next save must NOT be skipped by a stale
+        # newer step: step 4 is now ahead of latest_step (3).
+        assert manager.latest_step() == 3
+        assert manager.save(4, tr2.state) is True
+        manager.wait()
+        assert manager.latest_step() == 4
+        manager.close()
 
 
 # --- report robustness section --------------------------------------------

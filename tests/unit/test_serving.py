@@ -470,11 +470,18 @@ class TestContinuous:
         engine.close()
         return prompts, results, engine
 
+    @pytest.mark.slow
     def test_churn_parity_and_occupancy_beats_batch(self, model):
         """The acceptance criterion: staggered arrivals, mixed prompt
         AND output lengths — continuous outputs token-identical to
         per-request generate(), and mean decode-slot occupancy beats the
-        SAME workload through the PR 4 batch-synchronous scheduler."""
+        SAME workload through the PR 4 batch-synchronous scheduler.
+
+        Slow tier: runs the full churn workload through BOTH schedulers
+        on a real model (~20s on the CPU rig); scripts/check_serving.py's
+        churn phase asserts the same parity+occupancy contract e2e, and
+        the fast continuous-scheduler tests below keep the slot
+        lifecycle pinned per-commit."""
         config, params = model
         continuous = ServeConfig(
             max_new_tokens=5, prompt_buckets=(8, 16),
