@@ -91,6 +91,17 @@ SERVE_MAX_BATCH = 8
 SERVE_CHURN_REQUESTS = 24
 SERVE_CHURN_CHUNK = 8
 
+#: Shared-prefix churn probe: the same continuous engine with the
+#: prefix KV cache + chunked prefill on, many requests over a few long
+#: system prompts — the workload prefix reuse exists for.  Emits
+#: serve_prefix_hit_tokens_per_sec (prefill compute SKIPPED per second;
+#: the acceptance bar is beating the cold path's churn tokens/sec) and
+#: serve_ttft_p99_seconds (chunked prefill's tail-latency claim).
+SERVE_PREFIX_SYSTEM_PROMPTS = 3
+SERVE_PREFIX_BLOCKS = 64
+SERVE_PREFIX_BLOCK_TOKENS = 16
+SERVE_PREFILL_CHUNK = 32
+
 #: Fleet probe (cloud_tpu.fleet): the same churn workload through TWO
 #: engine replicas behind the health-aware router, so what the fleet
 #: layer adds (routing overhead) or buys (parallel replicas) is a
@@ -107,7 +118,13 @@ METRIC = f"resnet50_cifar10_b{BATCH_SIZE}_train_steps_per_sec_per_chip"
 RECORDED_BASELINE_STEPS_PER_SEC = 162.74
 
 #: Probe budget: jax import + device enumeration + one tiny matmul.
-PROBE_TIMEOUT_S = float(os.environ.get("CLOUD_TPU_BENCH_PROBE_TIMEOUT", 75))
+#: Raised 75 -> 150 after BENCH_r05 burned its ENTIRE budget on 13
+#: straight 75 s probe timeouts and reported 0.0: jax import plus the
+#: first (even tiny) compile on a slow rig can exceed 75 s without the
+#: tunnel being dead, and a wrongly-failed probe costs a whole backoff
+#: cycle.  The probe workload itself also shrank (64x64 matmuls, two
+#: chain links) — the probe proves liveness, not throughput.
+PROBE_TIMEOUT_S = float(os.environ.get("CLOUD_TPU_BENCH_PROBE_TIMEOUT", 150))
 #: Per-attempt wall-clock budget.  First TPU compile on this endpoint is
 #: ~20-40 s per program; the headline needs just one compile and prints
 #: within ~1-2 min of child start — the rest of the budget is context
@@ -221,9 +238,9 @@ def _probe_main() -> int:
     import jax.numpy as jnp
 
     devices = jax.devices()
-    x = jnp.ones((128, 128), jnp.bfloat16)
+    x = jnp.ones((64, 64), jnp.bfloat16)
     y = x
-    for _ in range(3):  # chained — a hung tunnel cannot satisfy the read
+    for _ in range(2):  # chained — a hung tunnel cannot satisfy the read
         y = y @ x
     checksum = float(y.astype(jnp.float32).sum())
     # Cache-miss vs cache-hit timing of one jitted matmul: the bench-side
@@ -702,6 +719,93 @@ def _measure_serving_churn(extras):
     )
 
 
+def _measure_serving_prefix(extras):
+    """Shared-prefix churn probe: requests drawn from a few long system
+    prompts (plus short unique tails) through the continuous scheduler
+    with the prefix KV cache and chunked prefill enabled.  Emits
+    ``serve_prefix_hit_tokens_per_sec`` — prefill tokens SKIPPED per
+    wall-clock second via KV reuse, the direct measure of what the
+    cache buys — and ``serve_ttft_p99_seconds`` beside the cold-path
+    churn metrics, so both levers (reuse and bounded prefill stalls)
+    are tracked per round.
+    """
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils.benchmarking import decode_setup
+
+    import numpy as np
+
+    cfg, params, _, _ = decode_setup(
+        batch_size=SERVE_MAX_BATCH, prompt_len=SERVE_PROMPT_BUCKET
+    )
+    serve = ServeConfig(
+        max_new_tokens=SERVE_NEW_TOKENS,
+        prompt_buckets=(SERVE_PROMPT_BUCKET // 2, SERVE_PROMPT_BUCKET),
+        num_slots=SERVE_MAX_BATCH,
+        chunk_tokens=SERVE_CHURN_CHUNK,
+        prefix_cache_blocks=SERVE_PREFIX_BLOCKS,
+        prefix_block_tokens=SERVE_PREFIX_BLOCK_TOKENS,
+        prefill_chunk_tokens=SERVE_PREFILL_CHUNK,
+        warmup=True,
+    )
+    rng = np.random.default_rng(3)
+    # Long shared heads: most of each prompt is one of a few system
+    # prompts, so steady-state lookups hit nearly the whole prompt.
+    head_len = (SERVE_PROMPT_BUCKET * 3) // 4
+    heads = [
+        rng.integers(1, cfg.vocab_size, head_len).astype(np.int32)
+        for _ in range(SERVE_PREFIX_SYSTEM_PROMPTS)
+    ]
+    prompts = []
+    for _ in range(SERVE_CHURN_REQUESTS):
+        tail = rng.integers(
+            1, cfg.vocab_size, int(rng.integers(1, 9))
+        ).astype(np.int32)
+        prompts.append(np.concatenate([
+            heads[int(rng.integers(len(heads)))], tail
+        ]))
+    budgets = rng.integers(
+        SERVE_NEW_TOKENS // 4, SERVE_NEW_TOKENS + 1, SERVE_CHURN_REQUESTS
+    )
+    with ServingEngine(params, cfg, serve, mesh=None) as engine:
+        engine.wait_ready()
+        engine.submit(prompts[0]).result()  # absorb residual first-dispatch
+        warm = engine.stats()
+        start = time.perf_counter()
+        futures = []
+        for i, prompt in enumerate(prompts):
+            futures.append(
+                engine.submit(prompt, max_new_tokens=int(budgets[i]))
+            )
+            if (i + 1) % (SERVE_MAX_BATCH // 2) == 0:
+                time.sleep(0.02)  # staggered waves, not one burst
+        results = [f.result() for f in futures]
+        wall = time.perf_counter() - start
+        stats = engine.stats()
+    ttfts = sorted(r.ttft_seconds for r in results)
+    total_tokens = sum(r.num_generated for r in results)
+    hit_tokens = stats["prefix_hit_tokens"] - warm["prefix_hit_tokens"]
+    lookups = (
+        stats["prefix_hits"] + stats["prefix_misses"]
+        - warm["prefix_hits"] - warm["prefix_misses"]
+    )
+    hits = stats["prefix_hits"] - warm["prefix_hits"]
+    extras["serve_prefix_hit_tokens_per_sec"] = round(hit_tokens / wall, 1)
+    extras["serve_prefix_hit_rate"] = round(
+        hits / lookups if lookups else 0.0, 3
+    )
+    extras["serve_prefix_tokens_per_sec"] = round(total_tokens / wall, 1)
+    extras["serve_ttft_p99_seconds"] = round(_latency_pct(ttfts, 0.99), 4)
+    extras["serve_ttft_p50_seconds"] = round(_latency_pct(ttfts, 0.5), 4)
+    extras["serve_prefix_evictions"] = (
+        stats["evictions"] - warm["evictions"]
+    )
+    extras["serve_prefix_config"] = (
+        f"SMALL slots{SERVE_MAX_BATCH} blocks{SERVE_PREFIX_BLOCKS}"
+        f"x{SERVE_PREFIX_BLOCK_TOKENS} pchunk{SERVE_PREFILL_CHUNK} "
+        f"heads{SERVE_PREFIX_SYSTEM_PROMPTS} n{SERVE_CHURN_REQUESTS}"
+    )
+
+
 def _measure_fleet(extras):
     """Fleet probe: the churn workload (staggered arrivals, mixed prompt
     AND output lengths) through ``cloud_tpu.fleet.Fleet`` fronting
@@ -895,6 +999,7 @@ def _child_main() -> int:
         (_measure_decode, "decode"),
         (_measure_serving, "serving"),
         (_measure_serving_churn, "serving_churn"),
+        (_measure_serving_prefix, "serving_prefix"),
         (_measure_fleet, "fleet"),
         (_measure_durability, "durability"),
     ):

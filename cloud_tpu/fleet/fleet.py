@@ -72,6 +72,12 @@ from cloud_tpu.utils import faults, retries
 
 logger = logging.getLogger(__name__)
 
+#: Leading tokens hashed into a request's router affinity key: sized to
+#: cover typical shared system-prompt heads without making every long
+#: unique prompt its own key.  Replicas tie-break toward the replica
+#: whose prefix cache likely holds these tokens' KV (router.py).
+AFFINITY_PREFIX_TOKENS = 32
+
 #: Fleet-owned threads (prefix-matched by the leak guards, same family
 #: as the serving engine's ``cloud-tpu-serve-*`` names).
 FLEET_ROUTER_THREAD_NAME = "cloud-tpu-fleet-router"
@@ -180,6 +186,9 @@ class _FleetRequest:
     deadline: Optional[float] = None
     #: Replica submits accepted so far (attempt N+1 is failover N).
     attempts: int = 0
+    #: Hash of the prompt's leading tokens — the router's
+    #: prefix-affinity tie-break key (ignored by routers without one).
+    affinity_key: Optional[int] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -207,9 +216,19 @@ class Fleet:
         router: Optional[LeastLoadedRouter] = None,
         start: bool = True,
     ):
+        import inspect
+
         self.config = config or FleetConfig()
         self._factory = engine_factory
         self._router = router or LeastLoadedRouter()
+        # Custom routers predating the prefix-affinity tie-break keep
+        # their two-argument pick(); probe the signature once.
+        try:
+            self._pick_takes_affinity = "affinity_key" in (
+                inspect.signature(self._router.pick).parameters
+            )
+        except (TypeError, ValueError):  # pragma: no cover - exotic pick
+            self._pick_takes_affinity = False
         self._route_policy = (
             self.config.route_policy
             if self.config.route_policy is not None
@@ -404,6 +423,9 @@ class Fleet:
             deadline=(
                 None if deadline_s is None else submitted + deadline_s
             ),
+            affinity_key=hash(
+                tuple(int(t) for t in prompt[:AFFINITY_PREFIX_TOKENS])
+            ),
         )
         cfg = self.config
         with self._cond:
@@ -523,7 +545,15 @@ class Fleet:
                 if self._closed and not self._draining:
                     raise FleetClosedError("fleet closed during routing")
                 candidates = list(self._replicas)
-            replica, health = self._router.pick(candidates, exclude=tried)
+            if self._pick_takes_affinity:
+                replica, health = self._router.pick(
+                    candidates, exclude=tried,
+                    affinity_key=request.affinity_key,
+                )
+            else:
+                replica, health = self._router.pick(
+                    candidates, exclude=tried
+                )
             if replica is None:
                 tried.clear()  # widen the next pass: a restarted or
                 # previously-full replica deserves a fresh look.
@@ -560,6 +590,12 @@ class Fleet:
             self._resolve(request, exc=exc, shed=shed)
             return
         request.attempts += 1
+        # Affinity follows the replica that actually ACCEPTED the
+        # request (a QueueFull failover must not re-stick a hot prefix
+        # to its cold fallback replica).
+        record = getattr(self._router, "record_affinity", None)
+        if record is not None:
+            record(request.affinity_key, replica.id)
         now = time.perf_counter()
         span_attrs = {
             "replica": replica.id,

@@ -13,7 +13,14 @@ the smallest load signal::
 Queue depth is work promised, active slots work in progress; their sum
 is the number of requests ahead of a new arrival, which under identical
 replicas is proportional to its expected wait.  Ties break toward the
-lowest replica id, so a cold fleet fills deterministically.
+lowest replica id, so a cold fleet fills deterministically — unless
+**prefix affinity** is on (``LeastLoadedRouter(prefix_affinity=True)``):
+then a tie breaks toward the replica that last served the same prompt
+prefix, so a hot prefix's KV blocks concentrate on replicas that
+already cache them (each engine's prefix cache is per-replica; spraying
+a shared system prompt across the fleet re-prefills it everywhere).
+Affinity NEVER overrides load — it only picks among equals — so the
+balancing contract is unchanged.
 
 ``exclude`` carries the ids already tried during the current failover
 pass — a replica that just raised ``QueueFullError`` must not be picked
@@ -23,23 +30,43 @@ set once it round-robins through everyone).
 
 from __future__ import annotations
 
+import collections
 from typing import Iterable, Optional, Tuple
 
 from cloud_tpu.fleet.replica import Replica
 
 
 class LeastLoadedRouter:
-    """Pick the ready replica with the smallest ``queue + active`` load."""
+    """Pick the ready replica with the smallest ``queue + active`` load.
+
+    ``prefix_affinity=True`` enables the tie-break memory: up to
+    ``affinity_capacity`` prefix keys map to the replica that last won
+    them (LRU-bounded — the map must not grow with unique-traffic
+    volume).  The fleet passes each request's ``affinity_key`` (a hash
+    of its leading tokens) through :meth:`pick`; callers that pass
+    ``None`` get the plain lowest-id tie-break.
+    """
+
+    def __init__(self, prefix_affinity: bool = False,
+                 affinity_capacity: int = 1024):
+        if affinity_capacity < 1:
+            raise ValueError(
+                f"affinity_capacity must be >= 1, got {affinity_capacity}"
+            )
+        self._affinity: Optional[collections.OrderedDict] = (
+            collections.OrderedDict() if prefix_affinity else None
+        )
+        self._affinity_capacity = affinity_capacity
 
     def pick(self, replicas: Iterable[Replica],
              exclude: Iterable[int] = (),
+             affinity_key: Optional[int] = None,
              ) -> Tuple[Optional[Replica], Optional[dict]]:
         """Return ``(replica, its health snapshot)`` or ``(None, None)``
         when no routable candidate exists (all excluded, draining,
         restarting, or unhealthy)."""
         excluded = set(exclude)
-        best: Optional[Replica] = None
-        best_health: Optional[dict] = None
+        tied: list = []  # (replica, health) rows at the best load
         best_load: Optional[int] = None
         for replica in replicas:
             if replica.id in excluded:
@@ -49,5 +76,34 @@ class LeastLoadedRouter:
                 continue
             load = Replica.load_of(health)
             if best_load is None or load < best_load:
-                best, best_health, best_load = replica, health, load
+                tied = [(replica, health)]
+                best_load = load
+            elif load == best_load:
+                tied.append((replica, health))
+        if not tied:
+            return None, None
+        best, best_health = min(tied, key=lambda row: row[0].id)
+        if (self._affinity is not None and affinity_key is not None
+                and len(tied) > 1):
+            preferred = self._affinity.get(affinity_key)
+            if preferred is not None:
+                for replica, health in tied:
+                    if replica.id == preferred:
+                        best, best_health = replica, health
+                        break
         return best, best_health
+
+    def record_affinity(self, affinity_key: Optional[int],
+                        replica_id: int) -> None:
+        """Remember that ``replica_id`` actually SERVED ``affinity_key``
+        (LRU-bounded).  Called by the fleet after a successful submit —
+        not from :meth:`pick` — so a candidate that rejected the request
+        (QueueFull failover to a cold replica) does not steal the
+        prefix's affinity from the replica whose cache still holds its
+        KV.  No-op without ``prefix_affinity`` or without a key."""
+        if self._affinity is None or affinity_key is None:
+            return
+        self._affinity[affinity_key] = replica_id
+        self._affinity.move_to_end(affinity_key)
+        while len(self._affinity) > self._affinity_capacity:
+            self._affinity.popitem(last=False)

@@ -137,11 +137,16 @@ def _quantize_kv(x):
     return quantize_array(x, axis=-1)
 
 
-def _cache_attention(q, cache_l, cur_len):
+def _cache_attention(q, cache_l, cur_len, *, chunk_causal: bool = False):
     """q [B, Tq, H, hd] against the layer cache {k, v[, *_scale]}
     [B, S, H, hd]; key j of row i is valid iff j < cur_len[i].  f32
     softmax, finite mask value (matching ops.flash_attention's semantics
     for fully-masked rows).
+
+    ``chunk_causal=True`` treats the queries as CONSECUTIVE cache
+    positions starting at ``cur_len - 1`` (the chunk-prefill case): key
+    j is valid for query t iff ``j < cur_len + t`` — causal over the
+    chunk, full visibility over everything already in the cache.
 
     Quantized caches use POST-SCALE algebra — scores = (q . k_q) *
     k_scale folded into the [B, H, Tq, S] scores, and v_scale folded
@@ -162,8 +167,15 @@ def _cache_attention(q, cache_l, cur_len):
     ) * scale
     if "k_scale" in cache_l:
         scores = fold(scores, cache_l["k_scale"])
-    valid = jnp.arange(s)[None, :] < cur_len[:, None]  # [B, S]
-    scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+    if chunk_causal:
+        # [B, Tq, S]: query t sits at cache position cur_len - 1 + t.
+        valid = jnp.arange(s)[None, None, :] < (
+            cur_len[:, None, None] + jnp.arange(q.shape[1])[None, :, None]
+        )
+        scores = jnp.where(valid[:, None, :, :], scores, -1e30)
+    else:
+        valid = jnp.arange(s)[None, :] < cur_len[:, None]  # [B, S]
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1)
     if "v_scale" in cache_l:
         weights = fold(weights, cache_l["v_scale"])
@@ -180,27 +192,43 @@ def _mlp(layer_params, y, config, rules):
     return layers.mlp_block_apply(layer_params["mlp"], y, rules=rules)
 
 
-def _decode_layer(layer_params, x, cache_l, cur_len, config, rules):
+def _decode_layer(layer_params, x, cache_l, cur_len, config, rules,
+                  write_pos=None):
     """One block on a single-token slice x [B, 1, D]; writes this step's
     k/v at position cur_len[i] and attends over the whole valid prefix
-    (including the just-written position)."""
+    (including the just-written position).
+
+    ``write_pos`` overrides the write index per row; an out-of-range
+    entry SUPPRESSES that row's write (drop-mode scatter).  The chunk
+    scheduler uses it to keep inactive slots from stomping their frozen
+    position — a row mid-way through a chunked prefill holds real KV
+    there (see ``decode_chunk_program``)."""
     b = x.shape[0]
     y = layers.rmsnorm_apply(layer_params["ln1"], x)
     q, k_new, v_new = transformer.qkv_project(
         layer_params["att"], y, cur_len[:, None], config
     )
     rows = jnp.arange(b)
+    wp = cur_len if write_pos is None else write_pos
     cache_l = dict(cache_l)
     if "k_scale" in cache_l:
         k_q, k_sc = _quantize_kv(k_new[:, 0])
         v_q, v_sc = _quantize_kv(v_new[:, 0])
-        cache_l["k"] = cache_l["k"].at[rows, cur_len].set(k_q)
-        cache_l["k_scale"] = cache_l["k_scale"].at[rows, cur_len].set(k_sc)
-        cache_l["v"] = cache_l["v"].at[rows, cur_len].set(v_q)
-        cache_l["v_scale"] = cache_l["v_scale"].at[rows, cur_len].set(v_sc)
+        cache_l["k"] = cache_l["k"].at[rows, wp].set(k_q, mode="drop")
+        cache_l["k_scale"] = cache_l["k_scale"].at[rows, wp].set(
+            k_sc, mode="drop"
+        )
+        cache_l["v"] = cache_l["v"].at[rows, wp].set(v_q, mode="drop")
+        cache_l["v_scale"] = cache_l["v_scale"].at[rows, wp].set(
+            v_sc, mode="drop"
+        )
     else:
-        cache_l["k"] = cache_l["k"].at[rows, cur_len].set(k_new[:, 0])
-        cache_l["v"] = cache_l["v"].at[rows, cur_len].set(v_new[:, 0])
+        cache_l["k"] = cache_l["k"].at[rows, wp].set(
+            k_new[:, 0], mode="drop"
+        )
+        cache_l["v"] = cache_l["v"].at[rows, wp].set(
+            v_new[:, 0], mode="drop"
+        )
     attended = _cache_attention(q, cache_l, cur_len + 1)
     att_out = layers.dense_apply(
         layer_params["att"]["out"], attended.reshape(b, 1, -1)
@@ -277,25 +305,27 @@ def _prefill_forward(params, prompt_tokens, prompt_lens, config, rules,
     return k_pref, v_pref, logits0
 
 
+def _kv_leaf_updates(k_raw, v_raw, config, quantized: bool):
+    """Cache-leaf update arrays for raw (pre-cast) k/v activations:
+    ``{"k", "v"}`` cast to the cache dtype, plus int8 + per-(position,
+    head) scales when the cache is quantized.  The one spelling of
+    "turn activations into cache bytes", shared by every cache writer —
+    batch prefill (:func:`_write_prefill`), slot insert, and the
+    chunk-prefill scatter (:func:`prefill_chunk_program`)."""
+    if quantized:
+        k_q, k_sc = _quantize_kv(k_raw)
+        v_q, v_sc = _quantize_kv(v_raw)
+        return {"k": k_q, "k_scale": k_sc, "v": v_q, "v_scale": v_sc}
+    return {"k": k_raw.astype(config.dtype),
+            "v": v_raw.astype(config.dtype)}
+
+
 def _write_prefill(cache, k_pref, v_pref, start, config):
     """Write a prefill's k/v stacks into ``cache`` at the 5-D ``start``
     index (quantizing first when the cache is int8)."""
-    if "k_scale" in cache:
-        for name, pref in (("k", k_pref), ("v", v_pref)):
-            q, sc = _quantize_kv(pref)
-            cache[name] = jax.lax.dynamic_update_slice(
-                cache[name], q, start
-            )
-            cache[f"{name}_scale"] = jax.lax.dynamic_update_slice(
-                cache[f"{name}_scale"], sc, start
-            )
-    else:
-        cache["k"] = jax.lax.dynamic_update_slice(
-            cache["k"], k_pref.astype(config.dtype), start
-        )
-        cache["v"] = jax.lax.dynamic_update_slice(
-            cache["v"], v_pref.astype(config.dtype), start
-        )
+    updates = _kv_leaf_updates(k_pref, v_pref, config, "k_scale" in cache)
+    for name, val in updates.items():
+        cache[name] = jax.lax.dynamic_update_slice(cache[name], val, start)
     return cache
 
 
@@ -314,11 +344,13 @@ def _prefill(params, prompt_tokens, prompt_lens, config, s, rules, mesh,
     return cache, logits0
 
 
-def _decode_step(params, cache, token, cur_len, config, rules, mesh):
+def _decode_step(params, cache, token, cur_len, config, rules, mesh,
+                 write_pos=None):
     """One single-token decode step for every row at once: embed
     ``token`` [B], run the scanned layer stack against the cache (each
-    row's k/v written at its ``cur_len``), return the updated cache and
-    the next-token logits [B, V].  The shared inner loop of
+    row's k/v written at its ``cur_len``, or ``write_pos`` when given —
+    see :func:`_decode_layer`), return the updated cache and the
+    next-token logits [B, V].  The shared inner loop of
     :func:`_decode_tokens`, :func:`beam_search`, and
     :func:`decode_chunk_program`."""
     x = layers.embedding_apply(
@@ -330,7 +362,8 @@ def _decode_step(params, cache, token, cur_len, config, rules, mesh):
     def layer_body(x, layer_slice):
         layer_params, cache_l = layer_slice
         x, cache_l = _decode_layer(
-            layer_params, x, cache_l, cur_len, config, rules
+            layer_params, x, cache_l, cur_len, config, rules,
+            write_pos=write_pos,
         )
         return x, cache_l
 
@@ -666,6 +699,18 @@ def insert_slot_program(
         cache, k_pref, v_pref, (zero, slot, zero, zero, zero), config
     )
 
+    state, tok0 = _arm_slot(state, logits0, prompt_len, slot,
+                            max_new_tokens, config, sample=sample, rng=rng)
+    return cache, state, tok0
+
+
+def _arm_slot(state, logits0, prompt_len, slot, max_new_tokens, config, *,
+              sample: SampleConfig, rng):
+    """Sample a just-prefilled slot's first token from its prefill
+    logits (exactly :func:`generate`'s ``tok0``) and write the slot
+    state — shared by :func:`insert_slot_program` (one-shot prefill) and
+    :func:`finalize_slot_program` (the last chunk of a chunked
+    prefill).  Returns ``(state, tok0)``."""
     rng = jax.random.PRNGKey(0) if rng is None else rng
     need_min = sample.eos_id is not None and sample.min_new_tokens > 0
     allow0 = jnp.full((1,), False) if need_min else None
@@ -686,7 +731,7 @@ def insert_slot_program(
     if "seen" in state:
         row = jnp.zeros((config.vocab_size,), bool).at[tok0].set(True)
         state["seen"] = state["seen"].at[slot].set(row)
-    return cache, state, tok0
+    return state, tok0
 
 
 def decode_chunk_program(
@@ -711,8 +756,11 @@ def decode_chunk_program(
     *mid-chunk* and stops advancing (its residual lanes still flow
     through the compute — that is the static-shape price — but its
     ``pos`` freezes and its emissions are masked out).  Inactive slots
-    contribute masked lanes only; their frozen-position cache writes are
-    overwritten by the next insert before they can ever be attended.
+    contribute masked lanes only, and their cache writes are SUPPRESSED
+    (drop-mode scatter at an out-of-range position): a slot mid-way
+    through a chunked prefill already holds real prompt KV at its frozen
+    position, so the old write-then-overwrite staleness argument no
+    longer covers inactive rows.
 
     Returns ``(cache, state, tokens, valid)`` with ``tokens``/``valid``
     shaped [num_slots, chunk_size]: ``valid[s, i]`` marks a real
@@ -731,8 +779,17 @@ def decode_chunk_program(
     def step(carry, step_rng):
         cache, state = carry
         active = state["active"]
+        # Inactive slots write NOWHERE (out-of-range index -> drop-mode
+        # scatter): their frozen position may hold a neighboring
+        # occupant's real KV — a slot mid-way through a CHUNKED prefill
+        # keeps its already-written prompt positions intact while the
+        # grid decodes around it.  (Pre-chunked-prefill the write was
+        # merely stale-but-harmless; now it would corrupt.)
+        s = cache["k"].shape[2]
+        write_pos = jnp.where(active, state["pos"], jnp.int32(s))
         cache, logits = _decode_step(
-            params, cache, state["tok"], state["pos"], config, rules, mesh
+            params, cache, state["tok"], state["pos"], config, rules, mesh,
+            write_pos=write_pos,
         )
         allow = (
             state["emitted"] >= sample.min_new_tokens if need_min else None
@@ -762,6 +819,197 @@ def decode_chunk_program(
         step, (cache, state), jax.random.split(rng, chunk_size)
     )
     return cache, state, toks.T, valid.T
+
+
+# --------------------------------------------------------------------------
+# Prefix caching + chunked prefill: the serving engine's prefill-side
+# programs.  A prompt's KV for positions [0, n) depends only on the token
+# ids at those positions (positions are absolute), so requests sharing a
+# prefix can share its KV bytes: ``cloud_tpu.serving`` keeps a pool of
+# KV *blocks* (:func:`init_prefix_pool`) keyed host-side by token-id
+# prefixes, copies the longest cached prefix into a slot row
+# (:func:`copy_prefix_program`), prefills only the uncached suffix in
+# bounded chunks (:func:`prefill_chunk_program` — also the chunked-
+# prefill primitive that keeps a long arrival from stalling in-flight
+# decode), arms the slot from the final chunk's logits
+# (:func:`finalize_slot_program`), and saves the prompt's new full
+# blocks back to the pool (:func:`save_prefix_program`).  Greedy outputs
+# stay token-identical to :func:`generate` — the chunk forward writes
+# the same cache bytes and takes the same last-position logits as the
+# one-shot prefill, just in pieces.
+
+
+def init_prefix_pool(config, num_blocks: int, block_tokens: int, *,
+                     rules: ShardingRules = DEFAULT_RULES, mesh=None,
+                     kv_quant: bool = False):
+    """The shared-prefix KV block pool: a zeroed cache pytree with
+    ``num_blocks`` rows of ``block_tokens`` positions each (leaves
+    [L, num_blocks, block_tokens, H, hd] — the same structure as the
+    slot cache, so copies are per-leaf slicing).  Which block holds
+    which token prefix is host-side bookkeeping
+    (``serving.prefix_cache.PrefixCacheManager``)."""
+    return _init_cache(config, num_blocks, block_tokens, rules, mesh,
+                       kv_quant=kv_quant)
+
+
+def copy_prefix_program(cache, pool, block_ids, slot):
+    """Copy pool blocks into the head of one slot row: block i lands at
+    positions ``[i * block_tokens, (i+1) * block_tokens)`` of slot
+    ``slot``.  ``block_ids`` is a traced [n_blocks] int32 vector (the
+    program specializes per prompt bucket: ``n_blocks = bucket_len //
+    block_tokens``); entries padded past the real hit may be out of
+    range — the gather clamps, and the garbage it copies lands at
+    positions the suffix prefill overwrites (or that attention masks,
+    beyond the prompt).  Pure data movement — no params, no forward
+    pass; this is the whole point of a prefix hit.  Returns the cache.
+    """
+    slot = jnp.asarray(slot, jnp.int32)
+    block_ids = jnp.asarray(block_ids, jnp.int32)
+    n_blocks = block_ids.shape[0]
+    zero = jnp.int32(0)
+    out = dict(cache)
+    for name, leaf in cache.items():
+        pool_leaf = pool[name]
+        bt = pool_leaf.shape[2]
+        gathered = jnp.take(pool_leaf, block_ids, axis=1, mode="clip")
+        l, _, _, h, w = gathered.shape
+        flat = gathered.reshape(l, 1, n_blocks * bt, h, w)
+        out[name] = jax.lax.dynamic_update_slice(
+            leaf, flat, (zero, slot, zero, zero, zero)
+        )
+    return out
+
+
+def save_prefix_program(pool, cache, slot, block_ids):
+    """The reverse copy: capture a just-prefilled slot row's head into
+    pool blocks (block i from positions ``[i * block_tokens, (i+1) *
+    block_tokens)``).  Out-of-range ``block_ids`` entries are the SKIP
+    sentinel — the scatter drops them — so already-cached blocks are
+    never rewritten (their bytes could differ in float lsb from a
+    different chunk partition, and in-flight slots may share them).
+    Returns the pool."""
+    slot = jnp.asarray(slot, jnp.int32)
+    block_ids = jnp.asarray(block_ids, jnp.int32)
+    n_blocks = block_ids.shape[0]
+    zero = jnp.int32(0)
+    out = dict(pool)
+    for name, pool_leaf in pool.items():
+        leaf = cache[name]
+        bt = pool_leaf.shape[2]
+        l, _, _, h, w = leaf.shape
+        row = jax.lax.dynamic_slice(
+            leaf, (zero, slot, zero, zero, zero),
+            (l, 1, n_blocks * bt, h, w),
+        )
+        blocks = row.reshape(l, n_blocks, bt, h, w)
+        out[name] = pool_leaf.at[:, block_ids].set(blocks, mode="drop")
+    return out
+
+
+def prefill_chunk_program(
+    params,
+    cache,
+    chunk_tokens: jnp.ndarray,
+    start,
+    chunk_len,
+    slot,
+    config: transformer.TransformerConfig,
+    *,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+):
+    """Prefill one bounded chunk of a prompt into one live slot row.
+
+    ``chunk_tokens`` is a [1, chunk_width] padded token slice covering
+    prompt positions ``[start, start + chunk_len)`` (the program
+    specializes per chunk width only — ``start``/``chunk_len``/``slot``
+    are traced int32 scalars, so ONE executable serves every slot,
+    every offset, and every request).  Each layer writes the chunk's
+    k/v into the slot row, then attends causally over the row —
+    positions already filled (a copied prefix hit, earlier chunks) plus
+    the chunk itself — so splitting a prefill into chunks writes the
+    same cache bytes as the one-shot prefill.  Padded chunk positions
+    write garbage past ``start + chunk_len``, which the next chunk (or
+    decode, position by position) overwrites before attention can ever
+    see it — the same staleness invariant as slot reuse.
+
+    Returns ``(cache, logits)`` with ``logits`` [1, V] taken at the
+    chunk's LAST REAL token; only the final chunk's logits mean
+    anything (feed them to :func:`finalize_slot_program`).
+    """
+    c = chunk_tokens.shape[1]
+    start = jnp.asarray(start, jnp.int32)
+    chunk_len = jnp.asarray(chunk_len, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    positions = (start + jnp.arange(c))[None, :]
+    pos_idx = start + jnp.arange(c)
+    quantized = "k_scale" in cache
+
+    x = layers.embedding_apply(params["embed"], chunk_tokens,
+                               dtype=config.dtype, rules=rules, mesh=mesh)
+    x = x * math.sqrt(config.dim)
+    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules,
+                         mesh=mesh)
+
+    def layer_body(x, layer_slice):
+        layer_params, cache_l = layer_slice
+        y = layers.rmsnorm_apply(layer_params["ln1"], x)
+        q, k_new, v_new = transformer.qkv_project(
+            layer_params["att"], y, positions, config
+        )
+        updates = _kv_leaf_updates(k_new[0], v_new[0], config, quantized)
+        cache_l = dict(cache_l)
+        for name, val in updates.items():
+            cache_l[name] = cache_l[name].at[slot, pos_idx].set(
+                val, mode="drop"
+            )
+        row = {
+            name: jax.lax.dynamic_slice_in_dim(leaf, slot, 1, axis=0)
+            for name, leaf in cache_l.items()
+        }
+        attended = _cache_attention(
+            q, row, jnp.reshape(start + 1, (1,)), chunk_causal=True
+        )
+        att_out = layers.dense_apply(
+            layer_params["att"]["out"], attended.reshape(1, c, -1)
+        )
+        x = x + att_out
+        y = layers.rmsnorm_apply(layer_params["ln2"], x)
+        x = x + _mlp(layer_params, y, config, rules)
+        x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules,
+                             mesh=mesh)
+        return x, cache_l
+
+    x, cache = jax.lax.scan(layer_body, x, (params["layers"], cache))
+    last_idx = jnp.clip(chunk_len - 1, 0, c - 1)[None, None, None]
+    last_x = jnp.take_along_axis(
+        x, jnp.broadcast_to(last_idx, (1, 1, x.shape[-1])), axis=1
+    )
+    logits = _final_logits(params, last_x, config)[:, 0]
+    return cache, logits
+
+
+def finalize_slot_program(
+    state,
+    logits0: jnp.ndarray,
+    prompt_len,
+    slot,
+    max_new_tokens,
+    config: transformer.TransformerConfig,
+    *,
+    sample: SampleConfig = SampleConfig(temperature=0.0),
+    rng: Optional[jax.Array] = None,
+):
+    """Arm one slot from a chunked prefill's final-chunk logits: sample
+    the first token and write the slot state EXACTLY as
+    :func:`insert_slot_program` would (same :func:`_arm_slot`), minus
+    the prefill it no longer needs to do.  One compile serves the whole
+    engine (logits shape is [1, V] regardless of bucket).  Returns
+    ``(state, first_token)``."""
+    prompt_len = jnp.asarray(prompt_len, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    return _arm_slot(state, logits0, prompt_len, slot, max_new_tokens,
+                     config, sample=sample, rng=rng)
 
 
 def check_inference_supported(config, rules, mesh, what: str = "inference"):

@@ -15,7 +15,12 @@ compute (shrink buckets, raise occupancy) — without leaving the CLI.
 Continuous-batching timelines (``serve/chunk`` spans) additionally get
 a grid-health line: chunk count, mean slot occupancy, mean active
 slots, and total emitted tokens, aggregated from the per-dispatch span
-attributes the scheduler stamps on every chunk.
+attributes the scheduler stamps on every chunk.  Prefix-cache /
+chunked-prefill timelines (``serve/prefix_lookup`` /
+``serve/prefill_chunk`` spans) get hit rate, hit tokens, prefill-chunk
+count, and decode-stall attribution (one interleaved prefill chunk is
+exactly the stall a decode chunk can see, so the max chunk duration is
+the worst stall of the run).
 
 Timelines with ``fleet/*`` spans (the ``cloud_tpu.fleet`` layer) get a
 **fleet** section: per-replica routed-request counts with mean
@@ -136,6 +141,50 @@ class TraceReport:
             "mean_active": mean_of("active"),
             "slots": mean_of("slots"),
             "tokens": sum(tokens) if tokens else None,
+        }
+
+    def prefix_summary(self) -> Optional[Dict[str, object]]:
+        """Aggregate the prefix-cache / chunked-prefill spans.
+
+        ``lookups``/``hits``/``hit_rate``/``hit_tokens`` come from
+        ``serve/prefix_lookup`` span attributes (the scheduler stamps
+        ``hit`` and ``hit_tokens`` per admission); ``prefill_chunks`` /
+        ``prefill_chunk_seconds`` / ``max_decode_stall_seconds`` from
+        the ``serve/prefill_chunk`` spans — the scheduler interleaves
+        exactly one prefill chunk between decode chunks, so a single
+        chunk's duration IS the decode stall a long arrival imposes,
+        and the max over chunks is the worst stall of the run.  None
+        when the timeline has neither span (prefix caching and chunked
+        prefill off, batch mode, or a non-serving trace).
+        """
+        lookups = 0
+        hits = 0
+        hit_tokens = 0
+        chunk_durs: List[float] = []
+        for event in self.events:
+            name = event.get("name", "")
+            args = event.get("args") or {}
+            if name == "serve/prefix_lookup":
+                lookups += 1
+                if args.get("hit"):
+                    hits += 1
+                tokens = args.get("hit_tokens")
+                if isinstance(tokens, (int, float)):
+                    hit_tokens += int(tokens)
+            elif name == "serve/prefill_chunk":
+                chunk_durs.append(event["dur"] / 1e6)
+        if not lookups and not chunk_durs:
+            return None
+        return {
+            "lookups": lookups,
+            "hits": hits,
+            "hit_rate": hits / lookups if lookups else None,
+            "hit_tokens": hit_tokens,
+            "prefill_chunks": len(chunk_durs),
+            "prefill_chunk_seconds": sum(chunk_durs),
+            "max_decode_stall_seconds": (
+                max(chunk_durs) if chunk_durs else None
+            ),
         }
 
     def serving_rows(self, rows: Optional[List[Dict[str, float]]] = None
@@ -437,6 +486,26 @@ class TraceReport:
                 parts.append(f"{continuous['tokens']:.0f} tokens")
             lines.append("")
             lines.append("continuous batching: " + " · ".join(parts))
+        prefix = self.prefix_summary()
+        if prefix:
+            parts = []
+            if prefix["lookups"]:
+                parts.append(
+                    f"{prefix['lookups']} lookups · "
+                    f"{prefix['hit_rate']:.1%} hit rate · "
+                    f"{prefix['hit_tokens']} hit tokens"
+                )
+            lines.append("")
+            lines.append(
+                "prefix cache: " + (" · ".join(parts) if parts else "off")
+            )
+            if prefix["prefill_chunks"]:
+                lines.append(
+                    f"chunked prefill: {prefix['prefill_chunks']} chunks · "
+                    f"{_fmt_s(prefix['prefill_chunk_seconds'])} total · "
+                    "max decode stall "
+                    f"{_fmt_s(prefix['max_decode_stall_seconds'])}"
+                )
         lines.append("")
         lines.append(
             f"{len(self.events)} spans over {_fmt_s(self.wall_seconds())} "

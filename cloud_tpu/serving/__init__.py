@@ -24,11 +24,14 @@ from cloud_tpu.serving.engine import (
     SERVE_DISPATCH_THREAD_NAME,
     SERVE_SCHEDULER_THREAD_NAME,
 )
+from cloud_tpu.serving.prefix_cache import PrefixCacheManager, PrefixHit
 
 __all__ = [
     "DeadlineExceededError",
     "DispatchTimeoutError",
     "EngineClosedError",
+    "PrefixCacheManager",
+    "PrefixHit",
     "QueueFullError",
     "ServeConfig",
     "ServeResult",

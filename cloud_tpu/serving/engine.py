@@ -22,6 +22,23 @@ with two schedulers sharing one submit/future/admission surface:
   a long neighbor's decode: occupancy is a steady-state quantity
   instead of the batch-synchronous sawtooth (Orca-style iteration
   scheduling — arxiv 2605.25645).
+* **Prefix caching** (``prefix_cache_blocks > 0``, continuous mode) —
+  requests sharing a prompt prefix (system prompts, few-shot headers)
+  share its KV bytes: a radix/token-trie manager
+  (``serving.prefix_cache``) keys a device pool of KV blocks by
+  token-id prefixes with ref-counting and LRU leaf eviction; on
+  admission the scheduler copies the longest cached prefix into the
+  slot row (``generation.copy_prefix_program``) and prefills only the
+  uncached suffix, then saves the prompt's new full blocks back.
+  Greedy outputs stay token-identical to a cold prefill — a hit moves
+  compute, never tokens.
+* **Chunked prefill** (``prefill_chunk_tokens``, continuous mode) —
+  prompt prefill splits into bounded chunks
+  (``generation.prefill_chunk_program``) the scheduler interleaves
+  with decode chunks, one prefill chunk per pass: a long arrival
+  stalls in-flight decode by at most one chunk dispatch instead of one
+  full prefill (the TTFT/tail-latency knob).  Both knobs default OFF —
+  the PR 5 one-shot insert path is the compatibility default.
 * **Dynamic batching** (``scheduler="batch"``, the PR 4 path) — the
   scheduler groups waiting requests by prompt-length bucket, pads each
   group to a static ``(bucket_len, batch_size)`` grid point, and
@@ -150,6 +167,23 @@ class ServeConfig:
     #: chunks admit/retire at finer granularity (lower latency under
     #: churn); large chunks amortize host dispatch overhead.
     chunk_tokens: int = 8
+    #: Shared-prefix KV cache (continuous mode): pool size in blocks.
+    #: 0 (default) disables — the compatibility default.  When set, the
+    #: scheduler looks up each arriving prompt's longest cached prefix,
+    #: copies its KV into the slot row (``generation.
+    #: copy_prefix_program``), and prefills only the uncached suffix;
+    #: completed prefills donate their new full blocks back to the
+    #: pool.  Greedy outputs stay token-identical either way.
+    prefix_cache_blocks: int = 0
+    #: Tokens per prefix block — the hit granularity (hits are whole
+    #: blocks; a prompt's trailing partial block never caches).
+    prefix_block_tokens: int = 16
+    #: Chunked prefill (continuous mode): split prompt prefill into
+    #: dispatches of this many tokens, interleaved with decode chunks,
+    #: so a long arrival stalls in-flight decode by at most ONE chunk
+    #: instead of one full prefill.  None (default) keeps the one-shot
+    #: insert prefill — the compatibility default.
+    prefill_chunk_tokens: Optional[int] = None
     #: Sampling config shared by every request (static: it specializes
     #: the compiled decode program).  Default greedy.
     sample: "SampleConfig" = None  # type: ignore[assignment]
@@ -205,6 +239,30 @@ class ServeConfig:
             raise ValueError(
                 f"chunk_tokens must be >= 1, got {self.chunk_tokens}"
             )
+        if self.prefix_cache_blocks < 0:
+            raise ValueError(
+                f"prefix_cache_blocks must be >= 0, got "
+                f"{self.prefix_cache_blocks}"
+            )
+        if self.prefix_block_tokens < 1:
+            raise ValueError(
+                f"prefix_block_tokens must be >= 1, got "
+                f"{self.prefix_block_tokens}"
+            )
+        if (self.prefill_chunk_tokens is not None
+                and self.prefill_chunk_tokens < 1):
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 1 or None, got "
+                f"{self.prefill_chunk_tokens}"
+            )
+        if self.scheduler == "batch" and (
+            self.prefix_cache_blocks or self.prefill_chunk_tokens is not None
+        ):
+            raise ValueError(
+                "prefix_cache_blocks / prefill_chunk_tokens need the "
+                "continuous scheduler (slot-grid prefill); the batch "
+                "path has no per-slot cache rows to reuse"
+            )
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
         if self.flush_deadline_s < 0:
@@ -234,6 +292,12 @@ class ServeResult:
     bucket_len: int
     batch_size: int
     latency_seconds: float
+    #: Submit -> first token known.  Under the continuous scheduler the
+    #: first token is sampled when the prefill lands, so this isolates
+    #: queueing + prefill (what prefix caching and chunked prefill move)
+    #: from decode.  The batch scheduler only materializes tokens when
+    #: the whole batch decode returns, so there it equals latency.
+    ttft_seconds: float = 0.0
 
 
 @dataclasses.dataclass
@@ -259,10 +323,33 @@ class _Slot:
     The device-side twin is the slot's row of the grid state
     (``generation.init_slot_state``); host and device transition in
     lockstep — both retire a slot exactly when its emission count hits
-    the request's ``max_new_tokens`` or the last emission was eos."""
+    the request's ``max_new_tokens`` or the last emission was eos.
+    ``prefix_nodes`` are the prefix-cache blocks this slot holds
+    references on (copied-in hit + saved-out new blocks), released when
+    the slot retires."""
 
     request: _Request
     tokens: List[int]
+    prefix_nodes: List[object] = dataclasses.field(default_factory=list)
+    first_token_ts: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _PrefillTask:
+    """A request mid-prefill (chunked prefill and/or a prefix hit): the
+    slot is claimed — ``_slot_table`` already holds its host mirror, so
+    a crash fails it — but decode has not started.  ``next_pos`` is the
+    first prompt position not yet prefilled; the scheduler advances the
+    OLDEST task by one ``chunk_width`` dispatch per pass, so in-flight
+    decode never waits more than one chunk on a long arrival."""
+
+    request: _Request
+    slot: int
+    chunk_width: int
+    next_pos: int
+    #: The acquired prefix hit (its KV was copied in before the first
+    #: chunk), or None on a cold prefill.
+    hit: Optional[object] = None
 
 
 class _Cell:
@@ -377,6 +464,8 @@ class ServingEngine:
             "decode_slot_steps": 0, "useful_decode_tokens": 0,
             # Continuous-mode churn counters.
             "inserts": 0, "retires": 0, "expired": 0, "chunks": 0,
+            # Prefix-cache / chunked-prefill counters (0 when disabled).
+            "prefill_chunks": 0, "prefix_hits": 0, "prefix_misses": 0,
             # Robustness counters: queue-shed deadlines, watchdog fires.
             "shed": 0, "watchdog_timeouts": 0,
         }
@@ -403,11 +492,36 @@ class ServingEngine:
             self._free_slots = list(range(cfg.num_slots))[::-1]
             self._active_slots: set = set()
             self._insert_cells: Dict[int, "compile_cache.AotStep"] = {}
+            #: Requests mid-prefill (chunked prefill / prefix hits):
+            #: FIFO, advanced one chunk dispatch per scheduler pass.
+            self._prefill_tasks: collections.deque = collections.deque()
+            self._chunk_prefill_cells: Dict[int, "compile_cache.AotStep"] = {}
+            self._finalize_step = None
+            self._copy_cells: Dict[int, "compile_cache.AotStep"] = {}
+            self._save_cells: Dict[int, "compile_cache.AotStep"] = {}
+            #: The shared-prefix block pool + its host-side radix
+            #: bookkeeping (None unless prefix_cache_blocks > 0).
+            self._prefix = None
+            self._prefix_pool = None
+            if cfg.prefix_cache_blocks:
+                from cloud_tpu.serving.prefix_cache import PrefixCacheManager
+
+                self._prefix = PrefixCacheManager(
+                    cfg.prefix_cache_blocks, cfg.prefix_block_tokens
+                )
+                self._prefix_pool = generation.init_prefix_pool(
+                    config, cfg.prefix_cache_blocks, cfg.prefix_block_tokens,
+                    rules=self.rules, mesh=self.mesh, kv_quant=cfg.kv_quant,
+                )
             #: Python-trace counters: the retrace guard for "one chunk
             #: compile serves the whole run" (tests/helpers/retrace_guard
             #: idiom — the wrapped body executes only while tracing).
             self._chunk_traces = 0
             self._insert_traces = 0
+            self._prefill_chunk_traces = 0
+            self._finalize_traces = 0
+            self._copy_traces = 0
+            self._save_traces = 0
             # Donating the grid through each dispatch keeps the cache
             # update in place; CPU ignores donation with a warning, so
             # only ask for it where the backend honors it.
@@ -622,6 +736,112 @@ class ServingEngine:
             self._insert_cells[bucket_len] = cell
         return cell
 
+    def _chunk_prefill_cell(self, width: int):
+        """The bounded-prefill program for one chunk width.  With
+        ``prefill_chunk_tokens`` set there is exactly one width (ONE
+        compile serves every prompt, offset, and slot); with only the
+        prefix cache on, suffix-after-hit prefills use the request's
+        bucket length as the width — one compile per bucket, like the
+        insert programs."""
+        cell = self._chunk_prefill_cells.get(width)
+        if cell is None:
+            import jax
+
+            from cloud_tpu.models import generation
+            from cloud_tpu.training import compile_cache
+
+            def chunk_prefill_fn(params, cache, tokens, start, chunk_len,
+                                 slot):
+                self._prefill_chunk_traces += 1
+                return generation.prefill_chunk_program(
+                    params, cache, tokens, start, chunk_len, slot,
+                    self.config, rules=self.rules, mesh=self.mesh,
+                )
+
+            donate = (1,) if self._donate else ()
+            cell = compile_cache.AotStep(
+                jax.jit(chunk_prefill_fn, donate_argnums=donate),
+                label=f"serve/prefill_chunk_W{width}",
+            )
+            self._chunk_prefill_cells[width] = cell
+        return cell
+
+    def _finalize_cell(self):
+        """Arm-the-slot program for the final prefill chunk: logits are
+        [1, vocab] whatever the bucket, so one compile serves the whole
+        engine."""
+        if self._finalize_step is None:
+            import jax
+
+            from cloud_tpu.models import generation
+            from cloud_tpu.training import compile_cache
+
+            cfg = self.serve_config
+
+            def finalize_fn(state, logits, prompt_len, slot, max_new, rng):
+                self._finalize_traces += 1
+                return generation.finalize_slot_program(
+                    state, logits, prompt_len, slot, max_new, self.config,
+                    sample=cfg.sample, rng=rng,
+                )
+
+            donate = (0,) if self._donate else ()
+            self._finalize_step = compile_cache.AotStep(
+                jax.jit(finalize_fn, donate_argnums=donate),
+                label="serve/finalize_slot",
+            )
+        return self._finalize_step
+
+    def _copy_cell(self, bucket_len: int):
+        """Pool-to-slot prefix copy for one prompt bucket (``n_blocks =
+        bucket_len // prefix_block_tokens`` is static per bucket; the
+        block-id vector is traced, so one executable serves every hit)."""
+        cell = self._copy_cells.get(bucket_len)
+        if cell is None:
+            import jax
+
+            from cloud_tpu.models import generation
+            from cloud_tpu.training import compile_cache
+
+            def copy_fn(cache, pool, block_ids, slot):
+                self._copy_traces += 1
+                return generation.copy_prefix_program(
+                    cache, pool, block_ids, slot
+                )
+
+            donate = (0,) if self._donate else ()
+            cell = compile_cache.AotStep(
+                jax.jit(copy_fn, donate_argnums=donate),
+                label=f"serve/prefix_copy_L{bucket_len}",
+            )
+            self._copy_cells[bucket_len] = cell
+        return cell
+
+    def _save_cell(self, bucket_len: int):
+        """Slot-to-pool block save for one prompt bucket (SKIP-sentinel
+        ids are dropped by the scatter, so already-cached blocks are
+        never rewritten)."""
+        cell = self._save_cells.get(bucket_len)
+        if cell is None:
+            import jax
+
+            from cloud_tpu.models import generation
+            from cloud_tpu.training import compile_cache
+
+            def save_fn(pool, cache, slot, block_ids):
+                self._save_traces += 1
+                return generation.save_prefix_program(
+                    pool, cache, slot, block_ids
+                )
+
+            donate = (0,) if self._donate else ()
+            cell = compile_cache.AotStep(
+                jax.jit(save_fn, donate_argnums=donate),
+                label=f"serve/prefix_save_L{bucket_len}",
+            )
+            self._save_cells[bucket_len] = cell
+        return cell
+
     def _start_warmup(self) -> None:
         """Queue AOT compiles for the whole grid on the compile-ahead
         worker (one background thread, in grid order — smallest programs
@@ -638,14 +858,57 @@ class ServingEngine:
             cache_avals = compile_cache.abstract_state(self._grid_cache)
             state_avals = compile_cache.abstract_state(self._slot_state)
             scalar = jax.ShapeDtypeStruct((), np.int32)
+            use_chunks = cfg.prefill_chunk_tokens is not None
             jobs = []
-            for bucket_len in cfg.prompt_buckets:
-                cell = self._insert_cell(bucket_len)
-                tok_aval = jax.ShapeDtypeStruct((1, bucket_len), np.int32)
+            if not use_chunks:
+                # One-shot inserts serve cold prefills (and with
+                # chunking on they are never dispatched — skip them).
+                for bucket_len in cfg.prompt_buckets:
+                    cell = self._insert_cell(bucket_len)
+                    tok_aval = jax.ShapeDtypeStruct(
+                        (1, bucket_len), np.int32
+                    )
+                    jobs.append((cell, (
+                        params_avals, cache_avals, state_avals, tok_aval,
+                        scalar, scalar, scalar, rng_aval,
+                    ), context))
+            # Chunked-prefill widths: THE chunk width when chunking is
+            # on; the per-bucket suffix widths when only the prefix
+            # cache drives partial prefills.
+            if use_chunks:
+                widths = (cfg.prefill_chunk_tokens,)
+            elif self._prefix is not None:
+                widths = cfg.prompt_buckets
+            else:
+                widths = ()
+            for width in widths:
+                cell = self._chunk_prefill_cell(width)
+                tok_aval = jax.ShapeDtypeStruct((1, width), np.int32)
                 jobs.append((cell, (
-                    params_avals, cache_avals, state_avals, tok_aval,
-                    scalar, scalar, scalar, rng_aval,
+                    params_avals, cache_avals, tok_aval, scalar, scalar,
+                    scalar,
                 ), context))
+            if widths:
+                logits_aval = jax.ShapeDtypeStruct(
+                    (1, self.config.vocab_size), np.float32
+                )
+                jobs.append((self._finalize_cell(), (
+                    state_avals, logits_aval, scalar, scalar, scalar,
+                    rng_aval,
+                ), context))
+            if self._prefix is not None:
+                pool_avals = compile_cache.abstract_state(self._prefix_pool)
+                for bucket_len in cfg.prompt_buckets:
+                    n_blocks = bucket_len // cfg.prefix_block_tokens
+                    if n_blocks < 1:
+                        continue
+                    ids_aval = jax.ShapeDtypeStruct((n_blocks,), np.int32)
+                    jobs.append((self._copy_cell(bucket_len), (
+                        cache_avals, pool_avals, ids_aval, scalar,
+                    ), context))
+                    jobs.append((self._save_cell(bucket_len), (
+                        pool_avals, cache_avals, scalar, ids_aval,
+                    ), context))
             jobs.append((self._chunk_step, (
                 params_avals, cache_avals, state_avals, rng_aval,
             ), context))
@@ -913,9 +1176,15 @@ class ServingEngine:
 
     def _continuous_loop(self) -> None:
         """Iteration-level scheduling: fill free slots from the queue,
-        run one chunk, retire what finished, repeat.  A dispatch failure
-        here is fatal to the grid (the cache/state pytrees may be
-        half-donated), so it propagates to the crash handler, which
+        advance at most ONE prefill chunk, run one decode chunk, retire
+        what finished, repeat.  The one-prefill-chunk bound is the
+        chunked-prefill latency contract: however long an arriving
+        prompt, in-flight decode waits at most one ``prefill_chunk_
+        tokens`` dispatch before its next chunk (without chunking a
+        prefill task is a single whole-suffix chunk, so the pass shape
+        degenerates to the old insert-then-decode loop).  A dispatch
+        failure here is fatal to the grid (the cache/state pytrees may
+        be half-donated), so it propagates to the crash handler, which
         fails every queued and in-flight request."""
         while True:
             inserts: List[Tuple[_Request, int]] = []
@@ -926,19 +1195,21 @@ class ServingEngine:
                         abort = True
                         break
                     self._pop_inserts_locked(inserts)
-                    if inserts or self._active_slots:
+                    if (inserts or self._active_slots
+                            or self._prefill_tasks):
                         break
                     if self._closed:
                         return  # draining and nothing left to serve
                     self._cond.wait()
             if abort:
+                self._prefill_tasks.clear()
                 self._fail_live_slots(EngineClosedError(
                     "engine closed without draining in-flight requests"
                 ))
                 return
             try:
                 for idx, (request, slot) in enumerate(inserts):
-                    self._insert_request(request, slot)
+                    self._admit_request(request, slot)
             except BaseException as exc:
                 # Requests popped from the queue but not yet in the slot
                 # table are invisible to the crash handler: fail them
@@ -956,6 +1227,8 @@ class ServingEngine:
                     with self._stats_lock:
                         self._stats["failed"] += failed
                 raise
+            if self._prefill_tasks:
+                self._advance_prefill()
             if self._active_slots:
                 self._dispatch_chunk()
 
@@ -983,10 +1256,210 @@ class ServingEngine:
         if popped:
             self._cond.notify_all()  # admission space freed
 
+    def _admit_request(self, request: _Request, slot: int) -> None:
+        """Route one popped request into its claimed slot.
+
+        With neither prefix caching nor chunked prefill configured this
+        IS the PR 5 one-shot insert (``_insert_request``).  Otherwise:
+        look up the longest cached prefix (``serve/prefix_lookup``),
+        pin its blocks — an acquire that fails because the blocks were
+        evicted since the match falls back to a cold prefill, never a
+        stale copy — copy the hit's KV into the slot row, and queue a
+        :class:`_PrefillTask` for the uncached suffix, which the loop
+        advances one chunk per pass."""
+        cfg = self.serve_config
+        use_chunks = cfg.prefill_chunk_tokens is not None
+        hit = None
+        held: List[object] = []
+        if self._prefix is not None:
+            with tracing.span("serve/prefix_lookup",
+                              bucket=request.bucket_len, slot=slot) as span:
+                candidate = self._prefix.match(request.prompt.tolist())
+                faults.fault_point("serve.prefix_acquire")
+                if candidate and self._prefix.acquire(candidate):
+                    hit = candidate
+                    held.extend(candidate.nodes)
+                span.set_attribute("hit", hit is not None)
+                span.set_attribute(
+                    "hit_tokens", hit.tokens if hit is not None else 0
+                )
+            if hit is not None:
+                metrics.counter_inc("serve/prefix_hits")
+                metrics.counter_inc("serve/prefix_hit_tokens", hit.tokens)
+                with self._stats_lock:
+                    self._stats["prefix_hits"] += 1
+            else:
+                metrics.counter_inc("serve/prefix_misses")
+                with self._stats_lock:
+                    self._stats["prefix_misses"] += 1
+        if hit is None and not use_chunks:
+            self._insert_request(request, slot)
+            return
+        now = time.perf_counter()
+        tracing.record_span(
+            "serve/queue_wait", request.submitted, now,
+            bucket=request.bucket_len, slot=slot,
+        )
+        # Tabled BEFORE any dispatch: a grid crash mid-prefill fails
+        # this request along with the live slots.
+        self._slot_table[slot] = _Slot(
+            request=request, tokens=[], prefix_nodes=held
+        )
+        if hit is not None and hit.tokens:
+            self._dispatch_copy(request, slot, hit)
+        width = (
+            cfg.prefill_chunk_tokens if use_chunks else request.bucket_len
+        )
+        self._prefill_tasks.append(_PrefillTask(
+            request=request, slot=slot, chunk_width=width,
+            next_pos=hit.tokens if hit is not None else 0, hit=hit,
+        ))
+
+    def _dispatch_copy(self, request: _Request, slot: int, hit) -> None:
+        """Copy an acquired hit's pool blocks into the slot row.  The
+        id vector pads with the hit's own last block (the gather clamps
+        out-of-range reads; padding with a REAL id keeps the copied-
+        then-overwritten garbage deterministic)."""
+        cfg = self.serve_config
+        n_blocks = request.bucket_len // cfg.prefix_block_tokens
+        blocks = hit.blocks
+        ids = np.full((n_blocks,), blocks[-1], np.int32)
+        ids[:len(blocks)] = blocks
+        cell = self._copy_cell(request.bucket_len)
+
+        def dispatch():
+            return cell(self._grid_cache, self._prefix_pool, ids,
+                        np.int32(slot))
+
+        with tracing.span("serve/prefix_copy", slot=slot,
+                          blocks=len(blocks), tokens=hit.tokens):
+            self._grid_cache = self._supervised(
+                "serve/prefix_copy", dispatch
+            )
+
+    def _advance_prefill(self) -> None:
+        """One prefill-chunk dispatch for the OLDEST mid-prefill request
+        — at most one per scheduler pass, so the next decode chunk is
+        never more than one chunk dispatch away.  The final chunk's
+        logits arm the slot (``_finalize_insert``)."""
+        task = self._prefill_tasks[0]
+        request = task.request
+        width = task.chunk_width
+        start_pos = task.next_pos
+        clen = min(request.prompt_len - start_pos, width)
+        tokens = np.zeros((1, width), np.int32)
+        tokens[0, :clen] = request.prompt[start_pos:start_pos + clen]
+        cell = self._chunk_prefill_cell(width)
+
+        def dispatch():
+            faults.fault_point("serve.prefill")
+            return cell(
+                self.params, self._grid_cache, tokens, np.int32(start_pos),
+                np.int32(clen), np.int32(task.slot),
+            )
+
+        with tracing.span("serve/prefill_chunk", bucket=request.bucket_len,
+                          slot=task.slot, start=start_pos, tokens=clen):
+            self._grid_cache, logits = self._supervised(
+                "serve/prefill_chunk", dispatch
+            )
+        task.next_pos = start_pos + clen
+        metrics.counter_inc("serve/prefill_chunks")
+        with self._stats_lock:
+            self._stats["prefill_chunks"] += 1
+        if task.next_pos >= request.prompt_len:
+            self._prefill_tasks.popleft()
+            self._finalize_insert(task, logits)
+
+    def _finalize_insert(self, task: _PrefillTask, logits) -> None:
+        """Arm a fully-prefilled slot from its last chunk's logits (the
+        device twin of what ``insert_slot_program`` does inline), save
+        the prompt's new prefix blocks, and activate — or retire, when
+        the first token already finishes the request."""
+        import jax
+
+        request, slot = task.request, task.slot
+        self._rng, fin_rng = jax.random.split(self._rng)
+        cell = self._finalize_cell()
+
+        def dispatch():
+            return cell(
+                self._slot_state, logits, np.int32(request.prompt_len),
+                np.int32(slot), np.int32(request.max_new_tokens), fin_rng,
+            )
+
+        with tracing.span("serve/prefill_finalize", slot=slot):
+            self._slot_state, tok0 = self._supervised(
+                "serve/prefill_finalize", dispatch
+            )
+            tok0 = int(np.asarray(tok0))
+        entry = self._slot_table[slot]
+        entry.tokens = [tok0]
+        entry.first_token_ts = time.perf_counter()
+        self._save_prefix_blocks(request, slot, already=task.hit)
+        self._activate_or_retire(slot, request, tok0)
+
+    def _save_prefix_blocks(self, request: _Request, slot: int,
+                            already=None) -> None:
+        """Donate a just-prefilled prompt's new full blocks to the pool
+        (no-op without the prefix cache).  The slot holds references on
+        everything it walked — copied-in hit and saved-out new blocks —
+        until it retires."""
+        if self._prefix is None:
+            return
+        from cloud_tpu.serving.prefix_cache import SKIP_BLOCK, PrefixHit
+
+        cfg = self.serve_config
+        if already is None:
+            already = PrefixHit(nodes=(), tokens=0)
+        held, created, evicted = self._prefix.insert(
+            request.prompt.tolist(), already
+        )
+        if evicted:
+            metrics.counter_inc("serve/prefix_evictions", evicted)
+        entry = self._slot_table[slot]
+        entry.prefix_nodes.extend(held)
+        if not created:
+            return
+        n_blocks = request.bucket_len // cfg.prefix_block_tokens
+        ids = np.full((n_blocks,), SKIP_BLOCK, np.int32)
+        created_set = {id(node) for node in created}
+        base = already.tokens // cfg.prefix_block_tokens
+        for i, node in enumerate(held):
+            if id(node) in created_set:
+                ids[base + i] = node.block
+        cell = self._save_cell(request.bucket_len)
+
+        def dispatch():
+            return cell(self._prefix_pool, self._grid_cache,
+                        np.int32(slot), ids)
+
+        with tracing.span("serve/prefix_save", slot=slot,
+                          blocks=len(created)):
+            self._prefix_pool = self._supervised(
+                "serve/prefix_save", dispatch
+            )
+        metrics.counter_inc("serve/prefix_saved_blocks", len(created))
+
+    def _activate_or_retire(self, slot: int, request: _Request,
+                            tok0: int) -> None:
+        """Post-prefill slot accounting, shared by the one-shot insert
+        and the chunked finalize (mirrors the programs' active0 gate)."""
+        with self._stats_lock:
+            self._stats["inserts"] += 1
+            self._stats["decode_slot_steps"] += 1  # the prefill emission
+            self._stats["useful_decode_tokens"] += 1
+        metrics.counter_inc("serve/slot_inserts")
+        eos = self.serve_config.sample.eos_id
+        if request.max_new_tokens == 1 or (eos is not None and tok0 == eos):
+            # Finished at insert (mirrors the program's active0 gate).
+            self._retire_slot(slot)
+        else:
+            self._active_slots.add(slot)
+
     def _insert_request(self, request: _Request, slot: int) -> None:
         import jax
 
-        cfg = self.serve_config
         start = time.perf_counter()
         tracing.record_span(
             "serve/queue_wait", request.submitted, start,
@@ -1011,18 +1484,12 @@ class ServingEngine:
                 "serve/prefill", dispatch
             )
             tok0 = int(np.asarray(tok0))
-        self._slot_table[slot] = _Slot(request=request, tokens=[tok0])
-        with self._stats_lock:
-            self._stats["inserts"] += 1
-            self._stats["decode_slot_steps"] += 1  # the prefill emission
-            self._stats["useful_decode_tokens"] += 1
-        metrics.counter_inc("serve/slot_inserts")
-        eos = cfg.sample.eos_id
-        if request.max_new_tokens == 1 or (eos is not None and tok0 == eos):
-            # Finished at insert (mirrors the program's active0 gate).
-            self._retire_slot(slot)
-        else:
-            self._active_slots.add(slot)
+        self._slot_table[slot] = _Slot(
+            request=request, tokens=[tok0],
+            first_token_ts=time.perf_counter(),
+        )
+        self._save_prefix_blocks(request, slot)
+        self._activate_or_retire(slot, request, tok0)
 
     def _dispatch_chunk(self) -> None:
         import jax
@@ -1076,6 +1543,10 @@ class ServingEngine:
         entry = self._slot_table[slot]
         self._slot_table[slot] = None
         self._active_slots.discard(slot)
+        if entry.prefix_nodes and self._prefix is not None:
+            # Drop this slot's references; blocks shared with another
+            # in-flight slot stay pinned until IT retires too.
+            self._prefix.release(entry.prefix_nodes)
         with self._cond:
             self._free_slots.append(slot)
         request = entry.request
@@ -1095,12 +1566,14 @@ class ServingEngine:
         row = np.full((m,), cfg.sample.pad_id, np.int32)
         row[:num] = entry.tokens[:num]
         done = time.perf_counter()
+        first = entry.first_token_ts if entry.first_token_ts else done
         result = ServeResult(
             tokens=row,
             num_generated=num,
             bucket_len=request.bucket_len,
             batch_size=cfg.num_slots,
             latency_seconds=done - request.submitted,
+            ttft_seconds=first - request.submitted,
         )
         metrics.distribution_record(
             "serve/latency_seconds", result.latency_seconds
@@ -1185,6 +1658,9 @@ class ServingEngine:
                 bucket_len=bucket_len,
                 batch_size=batch_size,
                 latency_seconds=done - request.submitted,
+                # Batch decode materializes tokens all at once: first
+                # token and last arrive together.
+                ttft_seconds=done - request.submitted,
             )
             metrics.distribution_record(
                 "serve/latency_seconds", result.latency_seconds
@@ -1231,9 +1707,10 @@ class ServingEngine:
         ``reason`` — why ``healthy`` is False, else None.  Plus the
         load signal a fleet router reads per routing decision —
         ``queue_depth`` (waiting requests; same value as the legacy
-        ``waiting`` key), ``active_slots`` (decode slots / batch rows on
-        the device right now, both schedulers), ``num_slots`` (the
-        engine's slot capacity, so occupancy is ``active/num``) — the
+        ``waiting`` key), ``active_slots`` (OCCUPIED slots / batch rows
+        on the device right now — decoding or mid-prefill, both
+        schedulers), ``num_slots`` (the engine's slot capacity, so
+        occupancy is ``active/num``) — the
         continuous grid's ``free_slots``, orphaned dispatch count, and
         seconds since the last device dispatch (None before the first)
         for staleness alerting.
@@ -1242,6 +1719,9 @@ class ServingEngine:
             waiting = self._waiting
             closed = self._closed
             thread = self._thread
+            free_slots = (
+                len(self._free_slots) if self._continuous else None
+            )
         live = thread is not None and thread.is_alive()
         reason = self._unhealthy_reason
         last = self._last_dispatch_ts
@@ -1253,9 +1733,13 @@ class ServingEngine:
             "closed": closed,
             "waiting": waiting,
             "queue_depth": waiting,
+            # OCCUPIED slots, not merely decoding ones: a slot claimed
+            # by a mid-prefill task (chunked prefill can hold it for
+            # many passes) is load a router must see — it left the
+            # queue-depth count the moment it was popped.
             "active_slots": (
-                len(self._active_slots) if self._continuous
-                else self._inflight_rows
+                self.serve_config.num_slots - free_slots
+                if self._continuous else self._inflight_rows
             ),
             "num_slots": self.serve_config.num_slots,
             "orphaned_dispatches": len(self._orphan_dispatches),
@@ -1263,9 +1747,26 @@ class ServingEngine:
                 None if last is None else time.perf_counter() - last
             ),
         }
+        snap.update(self._prefix_snapshot())
         if self._continuous:
-            snap["free_slots"] = len(self._free_slots)
+            snap["free_slots"] = free_slots
         return snap
+
+    def _prefix_snapshot(self) -> dict:
+        """The three prefix-cache keys ``health()`` and ``stats()`` both
+        carry (ONE spelling — the fleet router pins the schema): zeros
+        when the cache is off, so callers read a stable shape."""
+        prefix = (
+            self._prefix.stats()
+            if self._continuous and self._prefix is not None else None
+        )
+        return {
+            "prefix_cache_blocks": (
+                prefix["blocks_in_use"] if prefix else 0
+            ),
+            "prefix_hit_tokens": prefix["hit_tokens"] if prefix else 0,
+            "evictions": prefix["evictions"] if prefix else 0,
+        }
 
     def stats(self) -> dict:
         """Counters snapshot plus the two occupancy quotients.
@@ -1287,6 +1788,7 @@ class ServingEngine:
             snap["useful_decode_tokens"] / snap["decode_slot_steps"]
             if snap["decode_slot_steps"] else 0.0
         )
+        snap.update(self._prefix_snapshot())
         return snap
 
     @property

@@ -1,0 +1,290 @@
+"""Host-side bookkeeping for the shared-prefix KV block pool.
+
+The device half is ``generation.init_prefix_pool`` — ``num_blocks`` KV
+rows of ``block_tokens`` positions each, plus the copy/save programs
+that move blocks between the pool and the slot grid.  This module owns
+WHICH token prefix each block holds: a token-trie (radix tree at block
+granularity) where every node is one block, keyed by that block's
+token tuple, child nodes extending the prefix by one block.  A prompt's
+longest cached prefix is a root-down walk (:meth:`PrefixCacheManager.
+match`); the blocks it returns are the pool rows to copy.
+
+Lifecycle is reference-counted: a slot that copies blocks in (a hit) or
+saves new blocks out (a miss becoming tomorrow's hit) holds a reference
+on each until the slot retires, so a block shared by two in-flight
+requests survives either one finishing.  Eviction is LRU over
+*unreferenced leaves* — a parent can never leave before its children
+(the trie walk would dangle), and a referenced block never leaves at
+all.  When every block is pinned, :meth:`insert` simply caches less:
+the prefix cache is an accelerator, never a correctness dependency.
+
+``match`` does NOT pin.  The scheduler pins with :meth:`acquire`, which
+re-validates that every matched node is still live — a block evicted
+between lookup and insert (allocation pressure from a neighboring
+request in the same scheduling pass) fails the acquire, and the engine
+falls back to a cold prefill instead of copying a reused block's bytes
+(the no-stale-KV contract, pinned in tests/unit/test_serving_prefix.py).
+
+Everything here is plain host Python on the scheduler thread; a small
+lock guards the counters that ``health()``/``stats()`` read from other
+threads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: Scatter sentinel for "do not write this block": out of any real pool's
+#: range, so ``generation.save_prefix_program``'s drop-mode scatter skips
+#: it.  (Reads clamp rather than drop, so the COPY side pads with real
+#: hit ids instead — see ``ServingEngine._copy_prefix``.)
+SKIP_BLOCK = 2 ** 30
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics: nodes are unique,
+class _Node:                      # and the evictable set hashes them
+    """One cached block: ``key`` is this block's token tuple (the full
+    prefix is the root-down concatenation), ``block`` its pool row."""
+
+    key: Tuple[int, ...]
+    block: int
+    parent: Optional["_Node"]
+    children: Dict[Tuple[int, ...], "_Node"] = dataclasses.field(
+        default_factory=dict
+    )
+    refs: int = 0
+    last_used: int = 0
+    #: Flipped False on eviction: a PrefixHit holding this node fails
+    #: ``acquire`` instead of copying a reused block's bytes.
+    live: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixHit:
+    """A ``match`` result: the trie nodes of the longest cached prefix
+    (root-down order) and how many prompt tokens they cover.  Holds no
+    references until :meth:`PrefixCacheManager.acquire`."""
+
+    nodes: Tuple[_Node, ...]
+    tokens: int
+
+    @property
+    def blocks(self) -> List[int]:
+        return [node.block for node in self.nodes]
+
+    def __bool__(self) -> bool:
+        return bool(self.nodes)
+
+
+class PrefixCacheManager:
+    """Radix bookkeeping over a ``num_blocks``-row device pool."""
+
+    def __init__(self, num_blocks: int, block_tokens: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_tokens < 1:
+            raise ValueError(
+                f"block_tokens must be >= 1, got {block_tokens}"
+            )
+        self.num_blocks = num_blocks
+        self.block_tokens = block_tokens
+        self._root = _Node(key=(), block=-1, parent=None)
+        self._free: List[int] = list(range(num_blocks))[::-1]
+        #: Eviction candidates — nodes that WERE (refs == 0, childless)
+        #: at their last transition.  Maintained incrementally so an
+        #: allocation under pool pressure scans candidates, not the
+        #: whole trie (entries are re-validated at eviction time, so a
+        #: stale member is skipped, never wrongly evicted).
+        self._evictable: set = set()
+        self._clock = 0
+        self._lock = threading.Lock()
+        self._stats = {
+            "lookups": 0, "hits": 0, "misses": 0, "hit_tokens": 0,
+            "acquire_failures": 0, "evictions": 0, "saved_blocks": 0,
+        }
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def stats(self) -> dict:
+        with self._lock:
+            snap = dict(self._stats)
+        snap["blocks_in_use"] = self.blocks_in_use
+        return snap
+
+    def _count(self, **deltas) -> None:
+        with self._lock:
+            for key, delta in deltas.items():
+                self._stats[key] += delta
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    # -- lookup / pin ------------------------------------------------------
+
+    def _walk(self, tokens: Sequence[int], max_tokens: int) -> PrefixHit:
+        node = self._root
+        nodes: List[_Node] = []
+        offset = 0
+        while offset + self.block_tokens <= max_tokens:
+            key = tuple(
+                int(t) for t in tokens[offset:offset + self.block_tokens]
+            )
+            child = node.children.get(key)
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+            offset += self.block_tokens
+        return PrefixHit(nodes=tuple(nodes), tokens=offset)
+
+    def match(self, tokens: Sequence[int]) -> PrefixHit:
+        """Longest cached prefix of ``tokens``, in whole blocks, capped
+        at ``len(tokens) - 1`` tokens — at least the prompt's last token
+        always prefills, so even a fully cached prompt produces the
+        logits its first sampled token needs.  Counts a lookup (and the
+        miss, when nothing matched); a HIT is only counted by a
+        successful :meth:`acquire` — a match whose blocks evict before
+        the pin lands serves cold, and the stats must say so (the same
+        verdict the engine's own counters reach)."""
+        hit = self._walk(tokens, max(len(tokens) - 1, 0))
+        self._count(lookups=1, misses=0 if hit.nodes else 1)
+        return hit
+
+    def acquire(self, hit: PrefixHit) -> bool:
+        """Pin a match's blocks (ref +1 each, LRU bumped).  Returns
+        False — pinning NOTHING, counting a miss — if any node was
+        evicted since the match: the caller must fall back to a cold
+        prefill."""
+        if not hit.nodes:
+            return False
+        if not all(node.live for node in hit.nodes):
+            self._count(misses=1, acquire_failures=1)
+            return False
+        now = self._tick()
+        for node in hit.nodes:
+            node.refs += 1
+            node.last_used = now
+            self._evictable.discard(node)
+        self._count(hits=1, hit_tokens=hit.tokens)
+        return True
+
+    def release(self, nodes: Sequence[_Node]) -> None:
+        """Drop one reference per node (a retiring slot's held blocks).
+        Evicted-while-held nodes still count down safely."""
+        for node in nodes:
+            if node.refs > 0:
+                node.refs -= 1
+            if node.live and node.refs == 0 and not node.children:
+                self._evictable.add(node)
+
+    # -- insert / evict ----------------------------------------------------
+
+    def insert(self, tokens: Sequence[int],
+               already: PrefixHit,
+               ) -> Tuple[List[_Node], List[_Node], int]:
+        """Extend the trie with the full blocks of ``tokens`` beyond the
+        ``already``-cached prefix (the hit the caller copied in, or an
+        empty one).  Allocates pool rows — evicting LRU unreferenced
+        leaves as needed — and returns ``(held, created, evicted)``:
+        ``held`` is every walked node beyond the prefix (one reference
+        taken on each — the caller's slot releases them at retire;
+        in-flight siblings may have cached some of them since the
+        caller's match), ``created`` the subset whose pool rows are NEW
+        and must be written by ``save_prefix_program`` (existing blocks
+        are never rewritten — in-flight readers may share them), and
+        ``evicted`` how many LRU blocks THIS insert reclaimed.  Stops
+        early, caching less, when the pool is fully pinned.  The last
+        ``len(tokens) % block_tokens`` tokens never cache (partial
+        blocks are not addressable), and like :meth:`match` the
+        cacheable span is capped at ``len(tokens) - 1``."""
+        max_tokens = max(len(tokens) - 1, 0)
+        node = self._root if not already.nodes else already.nodes[-1]
+        offset = already.tokens
+        now = self._tick()
+        held: List[_Node] = []
+        created: List[_Node] = []
+        evicted = 0
+        while offset + self.block_tokens <= max_tokens:
+            key = tuple(
+                int(t) for t in tokens[offset:offset + self.block_tokens]
+            )
+            child = node.children.get(key)
+            if child is None:
+                block, from_eviction = self._allocate()
+                if block is None:
+                    break
+                evicted += 1 if from_eviction else 0
+                child = _Node(key=key, block=block, parent=node)
+                node.children[key] = child
+                self._evictable.discard(node)  # no longer a leaf
+                created.append(child)
+                self._count(saved_blocks=1)
+            child.refs += 1
+            child.last_used = now
+            self._evictable.discard(child)
+            held.append(child)
+            node = child
+            offset += self.block_tokens
+        return held, created, evicted
+
+    def _allocate(self) -> Tuple[Optional[int], bool]:
+        """A free pool row, or an evicted one: ``(block | None,
+        came_from_eviction)``."""
+        if self._free:
+            return self._free.pop(), False
+        block = self._evict_one()
+        return block, block is not None
+
+    def _evict_one(self) -> Optional[int]:
+        """Reclaim the LRU unreferenced LEAF block; None if every block
+        is referenced (or an interior parent of one).  Scans the
+        incrementally-maintained candidate set — not the trie — and
+        re-validates each member (stale entries are dropped), so the
+        scheduler-thread cost of an allocation under pool pressure is
+        bounded by the evictable population."""
+        victim: Optional[_Node] = None
+        stale = []
+        for node in self._evictable:
+            if not node.live or node.refs > 0 or node.children:
+                stale.append(node)
+                continue
+            if victim is None or node.last_used < victim.last_used:
+                victim = node
+        for node in stale:
+            self._evictable.discard(node)
+        if victim is None:
+            return None
+        self._evict_node(victim)
+        return victim.block
+
+    def _evict_node(self, victim: _Node) -> None:
+        victim.live = False
+        self._evictable.discard(victim)
+        parent = victim.parent
+        parent.children.pop(victim.key, None)
+        if (parent is not self._root and parent.live
+                and parent.refs == 0 and not parent.children):
+            self._evictable.add(parent)  # now an evictable leaf itself
+        self._count(evictions=1)
+
+    def evict_prefix(self, tokens: Sequence[int]) -> int:
+        """Force-evict every cached block along ``tokens``'s prefix that
+        is unreferenced and childless, deepest first (a test/ops hook —
+        the eviction-between-lookup-and-insert seam).  Returns the
+        number of blocks evicted."""
+        hit = self._walk(tokens, len(tokens))
+        evicted = 0
+        for node in reversed(hit.nodes):
+            if node.refs > 0 or node.children:
+                break
+            self._evict_node(node)
+            self._free.append(node.block)
+            evicted += 1
+        return evicted

@@ -19,7 +19,12 @@ workload on the continuous scheduler: staggered arrivals from jittered
 worker threads, mixed prompt lengths AND per-request ``max_new_tokens``
 — maximum slot churn (insert-into-freed-slot, mid-chunk expiry, eos-free
 retire all exercised) — with the same parity oracle plus the
-one-chunk-compile retrace guard.  Both occupancies are REPORTED for
+one-chunk-compile retrace guard.  Phase 3 is the shared-prefix churn:
+many requests over a few long system prompts with the prefix KV cache
+AND chunked prefill on — parity through partial hits and chunked
+suffixes, hit rate > 0, prefix programs compiling once per bucket (not
+per request), and ``prefix_hit_tokens_per_sec`` beating the cold churn
+phase's tokens/sec.  Both occupancies are REPORTED for
 trend-watching; the continuous-beats-batch assertion lives in
 tests/unit/test_serving.py, where the two schedulers run the identical
 workload (the two phases here deliberately differ).
@@ -180,6 +185,7 @@ def main(argv=None) -> int:
             threading.Thread(target=churn_submitter, args=(i,))
             for i in range(len(churn_prompts))
         ]
+        churn_start = time.perf_counter()
         for w in churn_workers:
             w.start()
         for w in churn_workers:
@@ -187,6 +193,7 @@ def main(argv=None) -> int:
         churn_results = [
             f.result(timeout=args.timeout) for f in churn_futures
         ]
+        churn_wall = time.perf_counter() - churn_start
 
         churn_mismatches = 0
         for prompt, budget, result in zip(churn_prompts, churn_budgets,
@@ -205,6 +212,8 @@ def main(argv=None) -> int:
         churn_stats = churn_engine.stats()
     finally:
         churn_engine.close()
+    churn_tokens = sum(r.num_generated for r in churn_results)
+    churn_tokens_per_sec = churn_tokens / churn_wall if churn_wall else 0.0
     print(json.dumps({
         "phase": "churn",
         "ok": churn_mismatches == 0,
@@ -214,30 +223,149 @@ def main(argv=None) -> int:
         "continuous_occupancy": round(
             churn_stats["mean_slot_occupancy"], 3
         ),
+        "tokens_per_sec": round(churn_tokens_per_sec, 1),
         "chunk_compiles": churn_engine.chunk_traces,
     }), flush=True)
     leaked_churn = _engine_threads()
 
+    # -- phase 3: shared-prefix churn (prefix cache + chunked prefill) ----
+    # Many requests over a few long system prompts: parity must hold
+    # through partial hits and chunked suffix prefills, the hit rate
+    # must be real, the prefix programs must compile once per bucket
+    # (not per request), and the KV the cache skips re-computing —
+    # hit tokens/sec — must beat the cold churn path's generated
+    # tokens/sec (the tentpole's reason to exist).
+    prefix_serve = ServeConfig(
+        max_new_tokens=MAX_NEW,
+        prompt_buckets=(8, 16),
+        batch_buckets=(1, 2, 4),
+        chunk_tokens=2,
+        prefix_cache_blocks=16,
+        prefix_block_tokens=4,
+        prefill_chunk_tokens=4,
+        warmup=True,
+    )
+    prefix_rng = np.random.default_rng(2)
+    heads = [
+        prefix_rng.integers(1, 255, 12).astype(np.int32) for _ in range(3)
+    ]
+    prefix_prompts = [
+        np.concatenate([
+            heads[i % len(heads)],
+            prefix_rng.integers(
+                1, 255, int(prefix_rng.integers(1, 4))
+            ).astype(np.int32),
+        ])
+        for i in range(args.requests)
+    ]
+    # Short decode budgets: the phase measures PREFILL-side reuse, and
+    # long decodes would dilute hit-tokens/sec with decode wall-clock
+    # (making the beats-cold-path assertion hostage to CPU-rig timing
+    # noise rather than to the cache actually working).
+    prefix_budgets = [
+        int(prefix_rng.integers(1, max(MAX_NEW // 2, 2)))
+        for _ in prefix_prompts
+    ]
+    prefix_futures = [None] * len(prefix_prompts)
+    prefix_engine = ServingEngine(params, config, prefix_serve, mesh=None)
+    try:
+        prefix_engine.wait_ready()
+
+        def prefix_submitter(i):
+            time.sleep(float(i % 5) * 0.005)
+            prefix_futures[i] = prefix_engine.submit(
+                prefix_prompts[i], max_new_tokens=prefix_budgets[i]
+            )
+
+        prefix_workers = [
+            threading.Thread(target=prefix_submitter, args=(i,))
+            for i in range(len(prefix_prompts))
+        ]
+        prefix_start = time.perf_counter()
+        for w in prefix_workers:
+            w.start()
+        for w in prefix_workers:
+            w.join()
+        prefix_results = [
+            f.result(timeout=args.timeout) for f in prefix_futures
+        ]
+        prefix_wall = time.perf_counter() - prefix_start
+
+        prefix_mismatches = 0
+        for prompt, budget, result in zip(prefix_prompts, prefix_budgets,
+                                          prefix_results):
+            direct = generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([len(prompt)], np.int32), config,
+                max_new_tokens=budget,
+                sample=generation.SampleConfig(temperature=0.0),
+            )
+            want = np.asarray(direct["tokens"])[0]
+            if not np.array_equal(result.tokens, want) or (
+                result.num_generated != int(direct["num_generated"][0])
+            ):
+                prefix_mismatches += 1
+        prefix_stats = prefix_engine.stats()
+    finally:
+        prefix_engine.close()
+    hit_tokens_per_sec = (
+        prefix_stats["prefix_hit_tokens"] / prefix_wall
+        if prefix_wall else 0.0
+    )
+    # Retrace guard: ONE chunk-prefill compile (one width), one
+    # finalize, and at most one copy + one save per prompt bucket.
+    n_buckets = len(prefix_serve.prompt_buckets)
+    prefix_retrace_ok = (
+        prefix_engine._prefill_chunk_traces <= 1
+        and prefix_engine._finalize_traces <= 1
+        and prefix_engine._copy_traces <= n_buckets
+        and prefix_engine._save_traces <= n_buckets
+        and prefix_engine.chunk_traces == 1
+    )
+    print(json.dumps({
+        "phase": "prefix_churn",
+        "ok": prefix_mismatches == 0,
+        "mismatches": prefix_mismatches,
+        "prefix_hits": prefix_stats["prefix_hits"],
+        "prefix_hit_tokens": prefix_stats["prefix_hit_tokens"],
+        "prefill_chunks": prefix_stats["prefill_chunks"],
+        "evictions": prefix_stats["evictions"],
+        "serve_prefix_hit_tokens_per_sec": round(hit_tokens_per_sec, 1),
+        "serve_churn_tokens_per_sec": round(churn_tokens_per_sec, 1),
+        "retrace_ok": prefix_retrace_ok,
+    }), flush=True)
+    leaked_prefix = _engine_threads()
+
     ok = (
         mismatches == 0 and churn_mismatches == 0
-        and not leaked and not leaked_churn
+        and prefix_mismatches == 0
+        and not leaked and not leaked_churn and not leaked_prefix
         and stats["completed"] == len(prompts)
         and churn_stats["completed"] == len(churn_prompts)
+        and prefix_stats["completed"] == len(prefix_prompts)
         # The whole churn run — reuse, expiry, staggered inserts — must
         # have retraced the chunk program exactly once.
         and churn_engine.chunk_traces == 1
+        # Shared-prefix phase: real hits, compile-once prefix programs,
+        # and KV reuse outpacing the cold path's token rate.
+        and prefix_stats["prefix_hits"] > 0
+        and prefix_retrace_ok
+        and hit_tokens_per_sec > churn_tokens_per_sec
     )
     print(json.dumps({
         "phase": "summary",
         "ok": ok,
-        "requests": stats["requests"] + churn_stats["requests"],
-        "completed": stats["completed"] + churn_stats["completed"],
+        "requests": (stats["requests"] + churn_stats["requests"]
+                     + prefix_stats["requests"]),
+        "completed": (stats["completed"] + churn_stats["completed"]
+                      + prefix_stats["completed"]),
         "batches": stats["batches"],
         "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 3),
         "continuous_occupancy": round(
             churn_stats["mean_slot_occupancy"], 3
         ),
-        "leaked_threads": leaked + leaked_churn,
+        "prefix_hit_tokens_per_sec": round(hit_tokens_per_sec, 1),
+        "leaked_threads": leaked + leaked_churn + leaked_prefix,
         "wall_seconds": round(time.perf_counter() - start, 3),
     }), flush=True)
     return 0 if ok else 1
