@@ -102,6 +102,24 @@ SERVE_PREFIX_BLOCKS = 64
 SERVE_PREFIX_BLOCK_TOKENS = 16
 SERVE_PREFILL_CHUNK = 32
 
+#: Tensor-parallel serving probe: the slot-grid churn workload through a
+#: sharded engine (ServeConfig(mesh_shape=(2, 1))) on a 2-device CPU
+#: mesh, next to the identical single-chip run.  Runs in its OWN child
+#: process (JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count=2
+#: must be set before jax initializes, and the measurement child may be
+#: holding a 1-chip TPU backend).  On virtual CPU devices the speedup is
+#: a plumbing/overhead trend number, not a hardware claim — two forced
+#: host devices share the same cores, so expect <= 1.0; the metric
+#: exists so the sharded path's dispatch overhead is tracked per round
+#: and a real multi-chip endpoint can publish a real speedup.
+SERVE_TP_REQUESTS = 8
+SERVE_TP_PROMPT_BUCKET = 16
+SERVE_TP_NEW_TOKENS = 12
+SERVE_TP_CHUNK = 4
+SERVE_TP_TIMEOUT_S = float(
+    os.environ.get("CLOUD_TPU_BENCH_SERVE_TP_TIMEOUT", 240)
+)
+
 #: Fleet probe (cloud_tpu.fleet): the same churn workload through TWO
 #: engine replicas behind the health-aware router, so what the fleet
 #: layer adds (routing overhead) or buys (parallel replicas) is a
@@ -238,7 +256,12 @@ def _probe_main() -> int:
     import jax.numpy as jnp
 
     devices = jax.devices()
-    x = jnp.ones((64, 64), jnp.bfloat16)
+    # 32x32: the probe proves liveness, not throughput — shrunk again
+    # (64 -> 32) after PR 9's shrink + timeout raise, so that if r06
+    # STILL times out the probe workload itself is provably negligible
+    # (jax import + first compile is then the whole cost) rather than
+    # shipping another 0.0 headline on probe overhead.
+    x = jnp.ones((32, 32), jnp.bfloat16)
     y = x
     for _ in range(2):  # chained — a hung tunnel cannot satisfy the read
         y = y @ x
@@ -806,6 +829,135 @@ def _measure_serving_prefix(extras):
     )
 
 
+def _serve_tp_main() -> int:
+    """The ``--serve-tp`` child: sharded-vs-single-chip serving churn.
+
+    Runs the SAME tiny-model churn workload twice — once through a
+    ``mesh_shape=(2, 1)`` engine (params + slot KV cache sharded over a
+    2-device mesh) and once single-chip — and prints one salvageable
+    JSON line with both rates, their ratio, and a parity count (every
+    sharded request token-checked against single-chip ``generate()``;
+    a parity miss zeroes the metrics rather than publishing a rate for
+    wrong tokens).  The spawning parent sets JAX_PLATFORMS=cpu and
+    forces 2 host devices before this process imports jax.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cloud_tpu.models import generation, transformer
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+
+    config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+    params = transformer.init(jax.random.PRNGKey(0), config)
+    rng = np.random.default_rng(4)
+    prompts = [
+        rng.integers(
+            1, config.vocab_size,
+            int(rng.integers(4, SERVE_TP_PROMPT_BUCKET + 1)),
+        ).astype(np.int32)
+        for _ in range(SERVE_TP_REQUESTS)
+    ]
+    budgets = [
+        int(rng.integers(SERVE_TP_NEW_TOKENS // 2, SERVE_TP_NEW_TOKENS + 1))
+        for _ in prompts
+    ]
+
+    def churn(mesh_shape):
+        serve = ServeConfig(
+            max_new_tokens=SERVE_TP_NEW_TOKENS,
+            prompt_buckets=(SERVE_TP_PROMPT_BUCKET,),
+            chunk_tokens=SERVE_TP_CHUNK,
+            mesh_shape=mesh_shape,
+            warmup=True,
+        )
+        with ServingEngine(params, config, serve) as engine:
+            engine.wait_ready()
+            engine.submit(prompts[0]).result()  # absorb first dispatch
+            start = time.perf_counter()
+            futures = [
+                engine.submit(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)
+            ]
+            results = [f.result() for f in futures]
+            wall = time.perf_counter() - start
+        tokens = sum(r.num_generated for r in results)
+        return results, tokens / wall if wall else 0.0
+
+    tp_results, tp_rate = churn((2, 1))
+    _, single_rate = churn(None)
+
+    mismatches = 0
+    for prompt, budget, result in zip(prompts, budgets, tp_results):
+        direct = generation.generate(
+            params, jnp.asarray(prompt[None, :]),
+            jnp.asarray([len(prompt)], np.int32), config,
+            max_new_tokens=budget,
+            sample=generation.SampleConfig(temperature=0.0),
+        )
+        if not np.array_equal(result.tokens, np.asarray(direct["tokens"])[0]):
+            mismatches += 1
+    ok = mismatches == 0
+    _emit_phase(
+        "serve_tp",
+        ok=ok,
+        extras={
+            "serve_tp_tokens_per_sec": round(tp_rate if ok else 0.0, 1),
+            "serve_tp_vs_single_chip_speedup": round(
+                tp_rate / single_rate if ok and single_rate else 0.0, 3
+            ),
+            "serve_tp_single_chip_tokens_per_sec": round(single_rate, 1),
+            "serve_tp_parity_mismatches": mismatches,
+            "serve_tp_config": (
+                f"TINY tp2 cpu-mesh bucket{SERVE_TP_PROMPT_BUCKET} "
+                f"new<= {SERVE_TP_NEW_TOKENS} chunk{SERVE_TP_CHUNK} "
+                f"n{SERVE_TP_REQUESTS}"
+            ),
+        },
+    )
+    return 0 if ok else 1
+
+
+def _measure_serving_tp(extras):
+    """Tensor-parallel serving probe: spawn the ``--serve-tp`` child on
+    a forced 2-device CPU platform (the measurement child itself may be
+    pinned to a 1-chip TPU backend, and jax's device count is frozen at
+    first use) and fold its metrics in.  A dead or timing-out child
+    raises, so the phase reports its own error line like every other
+    context phase."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    proc = _hardened_run(
+        [sys.executable, os.path.abspath(__file__), "--serve-tp"],
+        timeout=SERVE_TP_TIMEOUT_S,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env,
+    )
+    line = None
+    for raw in (proc.stdout or "").splitlines():
+        try:
+            candidate = json.loads(raw)
+        except ValueError:
+            continue
+        if isinstance(candidate, dict) and candidate.get("phase") == "serve_tp":
+            line = candidate
+    if line is None:
+        tail = (proc.stderr or proc.stdout or "").strip()[-300:]
+        raise RuntimeError(f"serve-tp child emitted no phase line: {tail!r}")
+    extras.update(line.get("extras") or {})
+    if not line.get("ok"):
+        raise RuntimeError(
+            "serve-tp child failed parity: "
+            f"{(line.get('extras') or {}).get('serve_tp_parity_mismatches')}"
+            " mismatched request(s)"
+        )
+
+
 def _measure_fleet(extras):
     """Fleet probe: the churn workload (staggered arrivals, mixed prompt
     AND output lengths) through ``cloud_tpu.fleet.Fleet`` fronting
@@ -1000,6 +1152,7 @@ def _child_main() -> int:
         (_measure_serving, "serving"),
         (_measure_serving_churn, "serving_churn"),
         (_measure_serving_prefix, "serving_prefix"),
+        (_measure_serving_tp, "serving_tp"),
         (_measure_fleet, "fleet"),
         (_measure_durability, "durability"),
     ):
@@ -1436,4 +1589,6 @@ if __name__ == "__main__":
         sys.exit(_probe_main())
     if "--child" in sys.argv:
         sys.exit(_child_main())
+    if "--serve-tp" in sys.argv:
+        sys.exit(_serve_tp_main())
     sys.exit(main())

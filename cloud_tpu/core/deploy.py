@@ -254,18 +254,30 @@ def build_serve_fleet_request(
     index, so a fronting router (or ``supervise_job``-style tooling) can
     enumerate the fleet by label.  The same request shape deploys through
     :func:`deploy_job` (each replica is just a node create).
+
+    Since sharded serving (one replica = one multi-chip slice) the wire
+    format also records the SLICE TOPOLOGY explicitly: each replica node
+    is a ``workers_per_replica``-host jax_graft process group over
+    ``chips_per_replica`` chips, with its own coordinator (host 0 of its
+    own slice) — the ``slice_topology`` block carries worker count, chip
+    count, and the per-replica coordinator map, so fleet tooling can
+    size health checks and dial slices without parsing startup scripts.
+    A single-chip fleet degenerates to ``workers_per_replica=1`` with
+    the same schema.
     """
     if num_replicas < 1:
         raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
     job_id = job_id or _job_id()
     hosts = plan.hosts_per_slice
     nodes = {}
+    coordinators = {}
     for i in range(num_replicas):
         node_id = f"{job_id}-r{i}"
+        coordinators[node_id] = f"{node_id}-w0:8476"
         nodes[node_id] = build_node_request(
             image_uri,
             replica_config,
-            coordinator_address=f"{node_id}-w0:8476",
+            coordinator_address=coordinators[node_id],
             num_processes=hosts,
             process_id_base=0,
             job_labels={
@@ -280,7 +292,16 @@ def build_serve_fleet_request(
             submit_ts=submit_ts,
             compile_cache=compile_cache,
         )
-    return {"job_id": job_id, "nodes": nodes, "role": "serve-fleet"}
+    return {
+        "job_id": job_id,
+        "nodes": nodes,
+        "role": "serve-fleet",
+        "slice_topology": {
+            "workers_per_replica": hosts,
+            "chips_per_replica": plan.chips_per_slice,
+            "coordinators": coordinators,
+        },
+    }
 
 
 def deploy_job(

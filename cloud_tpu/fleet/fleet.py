@@ -912,6 +912,15 @@ class Fleet:
             "replicas": snapshots,
             "num_replicas": len(replicas),
             "ready_replicas": ready,
+            # The fleet composes SLICES, not chips: each replica's
+            # health carries its slice_shape/slice_chips (1 per chip-
+            # replica, tp*sp for a sharded slice), and this is their
+            # sum — the fleet's hardware footprint.  Router load math
+            # is deliberately unchanged: load stays queued + in-flight
+            # requests per replica, whatever its slice width.
+            "total_chips": sum(
+                int(h.get("slice_chips") or 0) for h in snapshots
+            ),
             "queue_depth": queue_depth,
             "in_flight": in_flight,
         }

@@ -128,6 +128,7 @@ class Replica:
                 "healthy": False, "ready": False, "live": False,
                 "reason": f"replica {self.state}", "queue_depth": 0,
                 "active_slots": 0, "num_slots": 0,
+                "slice_shape": (0, 0), "slice_chips": 0,
                 "replica": self.id, "state": self.state,
             }
         snap = engine.health()

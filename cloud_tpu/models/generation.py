@@ -15,8 +15,15 @@ full re-forward — tested against exactly that in
 tests/unit/test_generation.py.
 
 Sharding: under a mesh, batch shards over dp/fsdp and heads over tp via
-the usual logical-axis constraints.  ``pp``/``zigzag_sp`` layouts are
-training-only and rejected up front.
+the usual logical-axis constraints.  The slot-grid program family
+(insert/decode-chunk/prefill-chunk/finalize, plus the prefix-pool
+copy/save pair) runs unchanged under a serving TP(xSP) mesh: the slot
+KV cache and block pool shard by attention head, params per the rules
+table, and logits reshard to replicated exactly once per forward — at
+the sampling boundary (``cloud_tpu.serving`` builds that mesh from
+``ServeConfig.mesh_shape``; greedy outputs stay token-identical to the
+single-chip path).  ``pp``/``zigzag_sp`` layouts are training-only and
+rejected up front.
 """
 
 from __future__ import annotations
@@ -302,6 +309,12 @@ def _prefill_forward(params, prompt_tokens, prompt_lens, config, rules,
         x, jnp.broadcast_to(last_idx, (b, 1, x.shape[-1])), axis=1
     )
     logits0 = _final_logits(params, last_x, config)[:, 0]
+    # Sampling boundary: the one place the sharded generation path
+    # resharding happens.  Under a tp mesh lm_logits comes back
+    # vocab-sharded; argmax/categorical need the full row, so gather it
+    # HERE (once per forward) and nowhere else.  No-op without a mesh.
+    logits0 = shard_constraint(logits0, "batch", None, rules=rules,
+                               mesh=mesh)
     return k_pref, v_pref, logits0
 
 
@@ -371,6 +384,10 @@ def _decode_step(params, cache, token, cur_len, config, rules, mesh,
         layer_body, x, (params["layers"], cache)
     )
     logits = _final_logits(params, x, config)[:, 0]
+    # Sampling boundary reshard (see _prefill_forward): vocab-sharded
+    # logits gather to replicated exactly once per decode step.
+    logits = shard_constraint(logits, "batch", None, rules=rules,
+                              mesh=mesh)
     return cache, logits
 
 
@@ -986,6 +1003,11 @@ def prefill_chunk_program(
         x, jnp.broadcast_to(last_idx, (1, 1, x.shape[-1])), axis=1
     )
     logits = _final_logits(params, last_x, config)[:, 0]
+    # Sampling-boundary reshard (see _prefill_forward): the final
+    # chunk's logits feed finalize_slot_program host-side, so they must
+    # leave the program replicated, not vocab-sharded.
+    logits = shard_constraint(logits, "batch", None, rules=rules,
+                              mesh=mesh)
     return cache, logits
 
 

@@ -15,7 +15,9 @@ compute (shrink buckets, raise occupancy) — without leaving the CLI.
 Continuous-batching timelines (``serve/chunk`` spans) additionally get
 a grid-health line: chunk count, mean slot occupancy, mean active
 slots, and total emitted tokens, aggregated from the per-dispatch span
-attributes the scheduler stamps on every chunk.  Prefix-cache /
+attributes the scheduler stamps on every chunk — plus the slice shape
+(``slice 2x1 (2 chips)``) next to occupancy when the engine is a
+sharded multi-chip slice.  Prefix-cache /
 chunked-prefill timelines (``serve/prefix_lookup`` /
 ``serve/prefill_chunk`` spans) get hit rate, hit tokens, prefill-chunk
 count, and decode-stall attribution (one interleaved prefill chunk is
@@ -135,12 +137,26 @@ class TraceReport:
             a["tokens"] for a in chunks
             if isinstance(a.get("tokens"), (int, float))
         ]
+        # Sharded engines stamp the slice ("2x1") and its chip count on
+        # every chunk span; single-chip timelines carry neither.
+        slice_shape = next(
+            (a["slice"] for a in chunks if a.get("slice")), None
+        )
+        slice_chips = next(
+            (
+                a["slice_chips"] for a in chunks
+                if isinstance(a.get("slice_chips"), (int, float))
+            ),
+            None,
+        )
         return {
             "chunks": len(chunks),
             "mean_occupancy": mean_of("occupancy"),
             "mean_active": mean_of("active"),
             "slots": mean_of("slots"),
             "tokens": sum(tokens) if tokens else None,
+            "slice": slice_shape,
+            "slice_chips": slice_chips,
         }
 
     def prefix_summary(self) -> Optional[Dict[str, object]]:
@@ -477,6 +493,13 @@ class TraceReport:
                 parts.append(
                     f"mean occupancy {continuous['mean_occupancy']:.1%}"
                 )
+            if continuous.get("slice"):
+                slice_part = f"slice {continuous['slice']}"
+                if continuous.get("slice_chips"):
+                    slice_part += (
+                        f" ({continuous['slice_chips']:.0f} chips)"
+                    )
+                parts.append(slice_part)
             if continuous["mean_active"] is not None:
                 active = f"mean active {continuous['mean_active']:.1f}"
                 if continuous["slots"]:

@@ -21,7 +21,13 @@ from cloud_tpu.parallel.mesh import (
     set_global_mesh,
     use_mesh,
 )
-from cloud_tpu.parallel.planner import MeshPlan, ParallelismHints, plan_mesh
+from cloud_tpu.parallel.planner import (
+    MeshPlan,
+    ParallelismHints,
+    ServeLayout,
+    plan_mesh,
+    plan_serve_layout,
+)
 from cloud_tpu.parallel.sharding import (
     ShardingRules,
     DEFAULT_RULES,
@@ -49,5 +55,7 @@ __all__ = [
     "logical_to_mesh_axes",
     "named_sharding",
     "plan_mesh",
+    "plan_serve_layout",
+    "ServeLayout",
     "shard_constraint",
 ]

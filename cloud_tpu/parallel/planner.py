@@ -123,6 +123,121 @@ class MeshPlan:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class ServeLayout:
+    """A fully-determined serving partition for one replica slice.
+
+    Serving replicas shard the *generation* path — params by head/mlp/
+    vocab, the slot KV cache (and prefix-cache block pool) by attention
+    head — over a ``tp`` (x ``sp``) mesh, so one replica spans a
+    multi-chip slice instead of one chip.  ``tp`` must divide the
+    model's head count (head-granular KV sharding); ``sp`` is sequence
+    parallelism over activations and defaults to 1.  The per-chip byte
+    fields are planning *estimates* (params and KV divide by ``tp``;
+    replicated norm scales are negligible), good enough to pick a
+    layout against an HBM budget, not an allocator.
+    """
+
+    tp: int
+    sp: int
+    description: str
+    param_bytes_per_chip: int = 0
+    kv_bytes_per_chip: int = 0
+
+    @property
+    def num_chips(self) -> int:
+        return self.tp * self.sp
+
+    @property
+    def shape(self) -> tuple:
+        return (self.tp, self.sp)
+
+    def mesh_spec(self) -> MeshSpec:
+        return MeshSpec(sizes={
+            mesh_lib.AXIS_SP: self.sp, mesh_lib.AXIS_TP: self.tp,
+        })
+
+
+def plan_serve_layout(
+    *,
+    num_heads: int,
+    num_devices: int,
+    param_bytes: int = 0,
+    kv_bytes: int = 0,
+    hbm_bytes_per_chip: Optional[int] = None,
+    sp: int = 1,
+) -> ServeLayout:
+    """Pick the tensor-parallel serving partition for one replica slice.
+
+    The serving analogue of :func:`plan_mesh` (AMP-style layout search,
+    PAPERS.md): from the model's head count, the slice's chip count, and
+    an optional per-chip HBM budget, choose the ``tp`` degree a
+    ``ServingEngine`` replica shards its generation programs over.
+
+    Candidates are every ``tp`` that divides ``num_heads`` (the KV cache
+    shards by head — a non-dividing degree would split a head) and fits
+    the slice (``tp * sp <= num_devices``).  Without a budget the
+    largest candidate wins: use the whole slice for per-request speed.
+    With ``hbm_bytes_per_chip``, the SMALLEST candidate whose estimated
+    per-chip bytes (params + KV, both ~1/tp) fit wins — sharding no
+    wider than memory requires leaves the remaining chips for more
+    replicas, which is the fleet's business, not the slice's.  Raises
+    ``ValueError`` (naming every number involved) when even the widest
+    candidate busts the budget.
+    """
+    if num_heads < 1:
+        raise ValueError(f"num_heads must be >= 1, got {num_heads}")
+    if num_devices < 1:
+        raise ValueError(f"num_devices must be >= 1, got {num_devices}")
+    if sp < 1:
+        raise ValueError(f"sp must be >= 1, got {sp}")
+    if sp > num_devices:
+        raise ValueError(
+            f"sp={sp} exceeds the slice's {num_devices} device(s)"
+        )
+    candidates = [
+        t for t in range(1, num_devices // sp + 1) if num_heads % t == 0
+    ]
+
+    def per_chip(tp: int) -> tuple:
+        return (param_bytes + tp - 1) // tp, (kv_bytes + tp - 1) // tp
+
+    if hbm_bytes_per_chip is None:
+        tp = candidates[-1]
+    else:
+        fitting = [
+            t for t in candidates
+            if sum(per_chip(t)) <= hbm_bytes_per_chip
+        ]
+        if not fitting:
+            widest = candidates[-1]
+            raise ValueError(
+                f"No serving layout fits hbm_bytes_per_chip="
+                f"{hbm_bytes_per_chip}: even tp={widest} (the widest "
+                f"divisor of num_heads={num_heads} within "
+                f"{num_devices} device(s), sp={sp}) needs "
+                f"{sum(per_chip(widest))} bytes/chip "
+                f"(params {param_bytes} + kv {kv_bytes} total). "
+                "Shrink the model/cache or grow the slice."
+            )
+        tp = fitting[0]
+    p_chip, k_chip = per_chip(tp)
+    description = (
+        f"serve slice {tp * sp} chip(s): tp={tp}"
+        + (f" x sp={sp}" if sp > 1 else "")
+        + f" ({num_heads} heads -> {num_heads // tp}/chip"
+        + (
+            f", ~{(p_chip + k_chip) >> 20} MiB/chip"
+            if param_bytes or kv_bytes else ""
+        )
+        + ")"
+    )
+    return ServeLayout(
+        tp=tp, sp=sp, description=description,
+        param_bytes_per_chip=p_chip, kv_bytes_per_chip=k_chip,
+    )
+
+
 def plan_mesh(
     chief_config: Optional[mc_lib.MachineConfig] = None,
     worker_count: int = 0,

@@ -39,6 +39,19 @@ with two schedulers sharing one submit/future/admission surface:
   stalls in-flight decode by at most one chunk dispatch instead of one
   full prefill (the TTFT/tail-latency knob).  Both knobs default OFF —
   the PR 5 one-shot insert path is the compatibility default.
+* **Sharded serving** (``mesh_shape=(tp, sp)`` / ``layout="auto"``) —
+  one replica spans a multi-chip slice: the whole slot-grid program
+  family runs under a TP(xSP) mesh with params sharded per the rules
+  table (heads/mlp/vocab over ``tp``), the slot KV cache and prefix
+  block pool sharded by attention head, and logits resharded to
+  replicated exactly once per forward, at the sampling boundary
+  (spanned host-side as ``serve/reshard``).  The layout comes from
+  ``parallel.planner.plan_serve_layout`` under ``layout="auto"``
+  (model head count x slice shape x HBM budget — the AMP-style search
+  already driving training); ``tp`` must divide ``num_heads`` (typed
+  error).  Unset / ``(1, 1)`` keeps the single-chip path
+  byte-identical, and greedy outputs on any slice are token-identical
+  to single-chip ``generate()`` — docs/serving.md "Sharded serving".
 * **Dynamic batching** (``scheduler="batch"``, the PR 4 path) — the
   scheduler groups waiting requests by prompt-length bucket, pads each
   group to a static ``(bucket_len, batch_size)`` grid point, and
@@ -201,6 +214,26 @@ class ServeConfig:
     #: one short-lived supervision thread per dispatch — serving rigs
     #: that want an SLO on "the device answered at all" opt in.
     dispatch_timeout_s: Optional[float] = None
+    #: Tensor-parallel serving slice: the ``(tp, sp)`` chip grid ONE
+    #: replica spans.  ``tp`` shards params (heads/mlp/vocab) and the
+    #: slot KV cache + prefix block pool by attention head — it must
+    #: divide the model's ``num_heads`` (typed error otherwise); ``sp``
+    #: is sequence parallelism over activations.  ``None`` or ``(1, 1)``
+    #: (the default) keeps the existing single-chip path byte-identical.
+    #: Greedy outputs on any slice are token-identical to single-chip
+    #: ``generate()`` — sharding moves bytes, never tokens.
+    mesh_shape: Optional[Tuple[int, int]] = None
+    #: ``"explicit"`` (default) uses ``mesh_shape`` verbatim;
+    #: ``"auto"`` asks ``parallel.planner.plan_serve_layout`` to pick
+    #: the slice partition from the model's head count, the visible
+    #: devices (bounded by ``mesh_shape`` when set), and
+    #: ``hbm_bytes_per_chip``.
+    layout: str = "explicit"
+    #: Per-chip HBM budget for ``layout="auto"`` (bytes).  ``None``
+    #: uses the whole slice (widest head-dividing tp) for per-request
+    #: speed; a budget picks the NARROWEST tp that fits, leaving chips
+    #: for more replicas.
+    hbm_bytes_per_chip: Optional[int] = None
 
     def __post_init__(self):
         from cloud_tpu.models.generation import SampleConfig
@@ -271,6 +304,24 @@ class ServeConfig:
             raise ValueError(
                 f"dispatch_timeout_s must be > 0 or None, "
                 f"got {self.dispatch_timeout_s}"
+            )
+        if self.layout not in ("explicit", "auto"):
+            raise ValueError(
+                f"layout must be 'explicit' or 'auto', got {self.layout!r}"
+            )
+        if self.mesh_shape is not None:
+            shape = tuple(int(v) for v in self.mesh_shape)
+            if len(shape) != 2 or any(v < 1 for v in shape):
+                raise ValueError(
+                    f"mesh_shape must be a (tp, sp) pair of positive "
+                    f"ints, got {self.mesh_shape!r}"
+                )
+            object.__setattr__(self, "mesh_shape", shape)
+        if (self.hbm_bytes_per_chip is not None
+                and self.hbm_bytes_per_chip < 1):
+            raise ValueError(
+                f"hbm_bytes_per_chip must be >= 1 or None, got "
+                f"{self.hbm_bytes_per_chip}"
             )
 
 
@@ -425,9 +476,19 @@ class ServingEngine:
         self.serve_config = serve_config or ServeConfig()
         self.rules = rules if rules is not None else DEFAULT_RULES
         self.mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
+        #: The replica's slice: (tp, sp) and total chips (= tp * sp).
+        #: (1, 1)/1 on the single-chip path; a ServeConfig.mesh_shape /
+        #: layout="auto" slice builds its own TP(xSP) mesh (flagged so
+        #: param placement only happens for engine-owned meshes — a
+        #: caller-provided mesh keeps the caller's placement).
+        self._built_serving_mesh = False
+        self._slice_shape, self._slice_chips = self._resolve_serving_mesh()
         generation.check_inference_supported(
             config, self.rules, self.mesh, "serving"
         )
+        if self._built_serving_mesh:
+            self._shard_params()
+        metrics.gauge_set("serve/slice_chips", self._slice_chips)
         # Engine-owned rng chain: split per batch (carried but
         # unobservable under greedy — one decode signature either way).
         self._rng = jax.random.PRNGKey(self.serve_config.seed)
@@ -480,13 +541,34 @@ class ServingEngine:
             #: Slot cache rows must fit the largest bucket's prompt plus
             #: the engine-wide decode budget.
             self._max_len = cfg.prompt_buckets[-1] + cfg.max_new_tokens
-            self._grid_cache = generation.init_slot_cache(
-                config, cfg.num_slots, self._max_len, rules=self.rules,
-                mesh=self.mesh, kv_quant=cfg.kv_quant,
+
+            def make_grid():
+                return generation.init_slot_cache(
+                    config, cfg.num_slots, self._max_len, rules=self.rules,
+                    mesh=self.mesh, kv_quant=cfg.kv_quant,
+                )
+
+            # Under a serving slice the grid is born head-sharded:
+            # building it INSIDE jit binds init_slot_cache's logical-
+            # axis constraints to the mesh, so every leaf lands
+            # [L, slots, S, H/tp, hd] per chip.  Single-chip keeps the
+            # eager allocation — byte-identical to the pre-slice path.
+            self._grid_cache = (
+                jax.jit(make_grid)() if self._slice_chips > 1
+                else make_grid()
             )
             self._slot_state = generation.init_slot_state(
                 config, cfg.num_slots, sample=cfg.sample
             )
+            if self._slice_chips > 1:
+                # Per-slot scalars are tiny: replicate them across the
+                # slice so every chip samples from the same state.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                self._slot_state = jax.device_put(
+                    self._slot_state,
+                    NamedSharding(self.mesh, PartitionSpec()),
+                )
             #: Scheduler-thread-only slot bookkeeping (the host mirror).
             self._slot_table: List[Optional[_Slot]] = [None] * cfg.num_slots
             self._free_slots = list(range(cfg.num_slots))[::-1]
@@ -509,9 +591,20 @@ class ServingEngine:
                 self._prefix = PrefixCacheManager(
                     cfg.prefix_cache_blocks, cfg.prefix_block_tokens
                 )
-                self._prefix_pool = generation.init_prefix_pool(
-                    config, cfg.prefix_cache_blocks, cfg.prefix_block_tokens,
-                    rules=self.rules, mesh=self.mesh, kv_quant=cfg.kv_quant,
+
+                def make_pool():
+                    return generation.init_prefix_pool(
+                        config, cfg.prefix_cache_blocks,
+                        cfg.prefix_block_tokens, rules=self.rules,
+                        mesh=self.mesh, kv_quant=cfg.kv_quant,
+                    )
+
+                # The block pool shards by head exactly like the slot
+                # grid (same pytree structure), so pool<->slot copies
+                # stay chip-local — no resharding on the hit path.
+                self._prefix_pool = (
+                    jax.jit(make_pool)() if self._slice_chips > 1
+                    else make_pool()
                 )
             #: Python-trace counters: the retrace guard for "one chunk
             #: compile serves the whole run" (tests/helpers/retrace_guard
@@ -532,6 +625,146 @@ class ServingEngine:
             self._start_warmup()
         if start:
             self.start()
+
+    # -- sharded serving ---------------------------------------------------
+
+    def _resolve_serving_mesh(self) -> Tuple[Tuple[int, int], int]:
+        """Build the replica's TP(xSP) serving mesh from ``ServeConfig``.
+
+        Returns ``((tp, sp), chips)``.  With ``mesh_shape`` unset (or
+        1x1) and ``layout="explicit"`` this does NOTHING — ``self.mesh``
+        stays exactly what the caller passed (usually None), which is
+        the byte-identical single-chip default; a caller-provided mesh
+        is honored as-is and only described here.  A nontrivial
+        ``mesh_shape``/``layout="auto"`` builds a fresh mesh over the
+        first ``tp * sp`` visible devices, with the head-divisibility
+        contract enforced as a typed error.
+        """
+        cfg = self.serve_config
+        wants = cfg.layout == "auto" or (
+            cfg.mesh_shape is not None and cfg.mesh_shape != (1, 1)
+        )
+        have_mesh = self.mesh is not None and not getattr(
+            self.mesh, "empty", False
+        )
+        if not wants:
+            if have_mesh:
+                # Caller-provided (or global) mesh: honored as-is — the
+                # caller owns param placement, the engine never touches
+                # it.  The slice is the mesh's SERVING-parallel extent,
+                # tp x sp: a pure dp/fsdp training mesh reads (1, 1)/1
+                # and keeps the exact pre-slice engine behavior (no
+                # reshard spans, eager grid init).
+                shape = dict(self.mesh.shape)
+                from cloud_tpu.parallel import mesh as mesh_lib
+
+                tp = int(shape.get(mesh_lib.AXIS_TP, 1))
+                sp = int(shape.get(mesh_lib.AXIS_SP, 1))
+                return (tp, sp), tp * sp
+            return (1, 1), 1
+        if have_mesh:
+            raise ValueError(
+                "pass either an explicit mesh= or "
+                "ServeConfig.mesh_shape/layout='auto', not both — the "
+                "engine builds its own serving mesh from the config"
+            )
+        import jax
+
+        from cloud_tpu.parallel import mesh as mesh_lib
+
+        devices = jax.devices()
+        bound = len(devices)
+        if cfg.mesh_shape is not None:
+            want = cfg.mesh_shape[0] * cfg.mesh_shape[1]
+            if want > bound:
+                raise ValueError(
+                    f"mesh_shape={cfg.mesh_shape} needs {want} "
+                    f"device(s); only {bound} visible"
+                )
+        num_heads = int(self.config.num_heads)
+        if cfg.layout == "auto":
+            from cloud_tpu.parallel import planner
+            # Generic array-pytree byte sum (despite the name — it is
+            # the repo's one accounting helper for this).
+            from cloud_tpu.training.optimizers import optimizer_state_bytes
+
+            plan = planner.plan_serve_layout(
+                num_heads=num_heads,
+                num_devices=(
+                    cfg.mesh_shape[0] * cfg.mesh_shape[1]
+                    if cfg.mesh_shape is not None else bound
+                ),
+                param_bytes=optimizer_state_bytes(self.params),
+                kv_bytes=self._kv_bytes_estimate(),
+                hbm_bytes_per_chip=cfg.hbm_bytes_per_chip,
+            )
+            tp, sp = plan.tp, plan.sp
+            logger.info("serving layout auto-picked: %s", plan.description)
+        else:
+            tp, sp = cfg.mesh_shape
+            if num_heads % tp:
+                raise ValueError(
+                    f"mesh_shape tp={tp} does not divide "
+                    f"num_heads={num_heads}: the slot KV cache shards "
+                    "by attention head, so the tensor-parallel degree "
+                    "must divide the model's head count"
+                )
+        chips = tp * sp
+        if chips <= 1:
+            return (1, 1), 1
+        self.mesh = mesh_lib.MeshSpec(
+            sizes={mesh_lib.AXIS_SP: sp, mesh_lib.AXIS_TP: tp}
+        ).build(devices[:chips])
+        self._built_serving_mesh = True
+        return (tp, sp), chips
+
+    def _kv_bytes_estimate(self) -> int:
+        """Total KV bytes the engine will allocate (slot grid + prefix
+        pool for the continuous scheduler, the largest batch cell
+        otherwise) — the planner's auto-layout input, an estimate, not
+        an allocator."""
+        cfg = self.serve_config
+        c = self.config
+        itemsize = 1 if cfg.kv_quant else np.dtype(c.dtype).itemsize
+        # Per cached position: k + v across every layer and head (+ the
+        # two f32 scale columns when quantized).
+        per_pos = 2 * c.num_layers * c.num_heads * (
+            c.head_dim * itemsize + (4 if cfg.kv_quant else 0)
+        )
+        max_len = cfg.prompt_buckets[-1] + cfg.max_new_tokens
+        if cfg.scheduler == "continuous":
+            positions = cfg.num_slots * max_len
+            positions += cfg.prefix_cache_blocks * cfg.prefix_block_tokens
+        else:
+            positions = cfg.batch_buckets[-1] * max_len
+        return per_pos * positions
+
+    def _shard_params(self) -> None:
+        """Place params per the rules table — heads/mlp/vocab dims over
+        ``tp`` (the plan :func:`parallel.planner.plan_serve_layout`
+        picked or ``mesh_shape`` pinned), everything else replicated —
+        so every generation program lowers against sharded weights."""
+        import jax
+
+        from cloud_tpu.models import transformer
+        from cloud_tpu.training.train import param_shardings
+
+        axes = transformer.param_logical_axes(self.config)
+        self.params = jax.device_put(
+            self.params, param_shardings(self.mesh, axes, self.rules)
+        )
+
+    def _to_host(self, what: str, *arrays):
+        """Materialize device results host-side.  On a sharded slice
+        this pull is the sampling boundary's logits/token gather — the
+        slice's only cross-chip reshard — and is spanned as
+        ``serve/reshard``; single-chip engines skip the span (their
+        timeline stays exactly the pre-slice shape)."""
+        if self._slice_chips > 1:
+            with tracing.span("serve/reshard", what=what,
+                              chips=self._slice_chips):
+                return tuple(np.asarray(a) for a in arrays)
+        return tuple(np.asarray(a) for a in arrays)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1392,7 +1625,7 @@ class ServingEngine:
             self._slot_state, tok0 = self._supervised(
                 "serve/prefill_finalize", dispatch
             )
-            tok0 = int(np.asarray(tok0))
+            tok0 = int(self._to_host("finalize_tok0", tok0)[0])
         entry = self._slot_table[slot]
         entry.tokens = [tok0]
         entry.first_token_ts = time.perf_counter()
@@ -1483,7 +1716,7 @@ class ServingEngine:
             self._grid_cache, self._slot_state, tok0 = self._supervised(
                 "serve/prefill", dispatch
             )
-            tok0 = int(np.asarray(tok0))
+            tok0 = int(self._to_host("insert_tok0", tok0)[0])
         self._slot_table[slot] = _Slot(
             request=request, tokens=[tok0],
             first_token_ts=time.perf_counter(),
@@ -1504,15 +1737,19 @@ class ServingEngine:
                 self.params, self._grid_cache, self._slot_state, chunk_rng,
             )
 
-        with tracing.span(
-            "serve/chunk", slots=num_slots, chunk=chunk,
-            active=len(self._active_slots),
-        ) as chunk_span:
+        span_attrs = dict(
+            slots=num_slots, chunk=chunk, active=len(self._active_slots),
+        )
+        if self._slice_chips > 1:
+            span_attrs["slice"] = (
+                f"{self._slice_shape[0]}x{self._slice_shape[1]}"
+            )
+            span_attrs["slice_chips"] = self._slice_chips
+        with tracing.span("serve/chunk", **span_attrs) as chunk_span:
             self._grid_cache, self._slot_state, toks, valid = (
                 self._supervised("serve/chunk", dispatch)
             )
-            toks = np.asarray(toks)
-            valid = np.asarray(valid)
+            toks, valid = self._to_host("chunk_tokens", toks, valid)
             emitted = int(valid.sum())
             occupancy = emitted / float(num_slots * chunk)
             chunk_span.set_attribute("tokens", emitted)
@@ -1639,7 +1876,9 @@ class ServingEngine:
         def decode():
             faults.fault_point("serve.decode")
             out = cell.decode(self.params, cache, logits0, lens, batch_rng)
-            return np.asarray(out["tokens"]), np.asarray(out["num_generated"])
+            return self._to_host(
+                "batch_tokens", out["tokens"], out["num_generated"]
+            )
 
         with tracing.span("serve/decode", bucket=bucket_len,
                           batch=batch_size):
@@ -1742,6 +1981,13 @@ class ServingEngine:
                 if self._continuous else self._inflight_rows
             ),
             "num_slots": self.serve_config.num_slots,
+            # The slice this replica spans: (tp, sp) and total chips.
+            # (1, 1)/1 on the single-chip path — stable schema, so a
+            # fleet can sum chips without probing.  Router load math
+            # deliberately ignores these: load is queued + in-flight
+            # REQUESTS, whatever the slice width serving them.
+            "slice_shape": self._slice_shape,
+            "slice_chips": self._slice_chips,
             "orphaned_dispatches": len(self._orphan_dispatches),
             "last_dispatch_age_s": (
                 None if last is None else time.perf_counter() - last
@@ -1788,6 +2034,8 @@ class ServingEngine:
             snap["useful_decode_tokens"] / snap["decode_slot_steps"]
             if snap["decode_slot_steps"] else 0.0
         )
+        snap["slice_shape"] = self._slice_shape
+        snap["slice_chips"] = self._slice_chips
         snap.update(self._prefix_snapshot())
         return snap
 

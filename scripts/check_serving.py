@@ -27,7 +27,12 @@ per request), and ``prefix_hit_tokens_per_sec`` beating the cold churn
 phase's tokens/sec.  Both occupancies are REPORTED for
 trend-watching; the continuous-beats-batch assertion lives in
 tests/unit/test_serving.py, where the two schedulers run the identical
-workload (the two phases here deliberately differ).
+workload (the two phases here deliberately differ).  Phase 4 is the
+SHARDED churn: the same staggered mixed-budget workload through a
+``mesh_shape=(2, 1)`` engine on a 2-device CPU mesh — params and the
+slot KV cache sharded over the slice — with per-request parity against
+single-chip ``generate()``, the one-executable-per-bucket retrace guard
+despite the mesh, and the same zero-thread-leak contract.
 
 Prints one JSON line per phase plus a final summary::
 
@@ -49,6 +54,14 @@ import time
 
 # CPU by default: this is a correctness/hygiene harness, not a perf one.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Two virtual devices BEFORE jax initializes: phase 4 runs the sharded
+# (TP=2 slice) engine; phases 1-3 ignore the second device (mesh=None
+# dispatches on the default device as before).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -336,13 +349,106 @@ def main(argv=None) -> int:
     }), flush=True)
     leaked_prefix = _engine_threads()
 
+    # -- phase 4: sharded churn (one replica = one TP=2 slice) ------------
+    # The phase-2 churn workload through a sharded engine: params +
+    # slot KV cache sharded over a 2-device mesh, parity per request
+    # against single-chip generate(), one executable per program per
+    # bucket DESPITE the mesh, zero leaked threads after close().
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "sharded phase needs 2 devices; XLA_FLAGS device forcing "
+            "did not take (jax initialized before this script?)"
+        )
+    tp_serve = ServeConfig(
+        max_new_tokens=MAX_NEW,
+        prompt_buckets=(8, 16),
+        batch_buckets=(1, 2, 4),
+        chunk_tokens=2,
+        mesh_shape=(2, 1),
+        warmup=True,
+    )
+    tp_rng = np.random.default_rng(3)
+    tp_prompts = [
+        tp_rng.integers(1, 255, int(tp_rng.integers(2, 17))).astype(
+            np.int32
+        )
+        for _ in range(args.requests)
+    ]
+    tp_budgets = [
+        int(tp_rng.integers(1, MAX_NEW + 1)) for _ in tp_prompts
+    ]
+    tp_futures = [None] * len(tp_prompts)
+    tp_engine = ServingEngine(params, config, tp_serve)
+    try:
+        tp_engine.wait_ready()
+
+        def tp_submitter(i):
+            time.sleep(float(i % 5) * 0.005)
+            tp_futures[i] = tp_engine.submit(
+                tp_prompts[i], max_new_tokens=tp_budgets[i]
+            )
+
+        tp_workers = [
+            threading.Thread(target=tp_submitter, args=(i,))
+            for i in range(len(tp_prompts))
+        ]
+        tp_start = time.perf_counter()
+        for w in tp_workers:
+            w.start()
+        for w in tp_workers:
+            w.join()
+        tp_results = [f.result(timeout=args.timeout) for f in tp_futures]
+        tp_wall = time.perf_counter() - tp_start
+
+        tp_mismatches = 0
+        for prompt, budget, result in zip(tp_prompts, tp_budgets,
+                                          tp_results):
+            direct = generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([len(prompt)], np.int32), config,
+                max_new_tokens=budget,
+                sample=generation.SampleConfig(temperature=0.0),
+            )
+            want = np.asarray(direct["tokens"])[0]
+            if not np.array_equal(result.tokens, want) or (
+                result.num_generated != int(direct["num_generated"][0])
+            ):
+                tp_mismatches += 1
+        tp_stats = tp_engine.stats()
+        tp_health = tp_engine.health()
+    finally:
+        tp_engine.close()
+    tp_tokens = sum(r.num_generated for r in tp_results)
+    # Retrace guard under the mesh: ONE chunk executable, at most one
+    # insert executable per prompt bucket.
+    tp_retrace_ok = (
+        tp_engine.chunk_traces == 1
+        and tp_engine._insert_traces <= len(tp_serve.prompt_buckets)
+    )
+    print(json.dumps({
+        "phase": "sharded_churn",
+        "ok": tp_mismatches == 0,
+        "mismatches": tp_mismatches,
+        "slice_shape": list(tp_health["slice_shape"]),
+        "slice_chips": tp_health["slice_chips"],
+        "inserts": tp_stats["inserts"],
+        "chunks": tp_stats["chunks"],
+        "tokens_per_sec": round(
+            tp_tokens / tp_wall if tp_wall else 0.0, 1
+        ),
+        "retrace_ok": tp_retrace_ok,
+    }), flush=True)
+    leaked_tp = _engine_threads()
+
     ok = (
         mismatches == 0 and churn_mismatches == 0
-        and prefix_mismatches == 0
+        and prefix_mismatches == 0 and tp_mismatches == 0
         and not leaked and not leaked_churn and not leaked_prefix
+        and not leaked_tp
         and stats["completed"] == len(prompts)
         and churn_stats["completed"] == len(churn_prompts)
         and prefix_stats["completed"] == len(prefix_prompts)
+        and tp_stats["completed"] == len(tp_prompts)
         # The whole churn run — reuse, expiry, staggered inserts — must
         # have retraced the chunk program exactly once.
         and churn_engine.chunk_traces == 1
@@ -351,21 +457,27 @@ def main(argv=None) -> int:
         and prefix_stats["prefix_hits"] > 0
         and prefix_retrace_ok
         and hit_tokens_per_sec > churn_tokens_per_sec
+        # Sharded phase: a real 2-chip slice, compile-once programs.
+        and tp_health["slice_chips"] == 2
+        and tp_retrace_ok
     )
     print(json.dumps({
         "phase": "summary",
         "ok": ok,
         "requests": (stats["requests"] + churn_stats["requests"]
-                     + prefix_stats["requests"]),
+                     + prefix_stats["requests"] + tp_stats["requests"]),
         "completed": (stats["completed"] + churn_stats["completed"]
-                      + prefix_stats["completed"]),
+                      + prefix_stats["completed"]
+                      + tp_stats["completed"]),
         "batches": stats["batches"],
         "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 3),
         "continuous_occupancy": round(
             churn_stats["mean_slot_occupancy"], 3
         ),
         "prefix_hit_tokens_per_sec": round(hit_tokens_per_sec, 1),
-        "leaked_threads": leaked + leaked_churn + leaked_prefix,
+        "sharded_slice_chips": tp_health["slice_chips"],
+        "leaked_threads": (leaked + leaked_churn + leaked_prefix
+                           + leaked_tp),
         "wall_seconds": round(time.perf_counter() - start, 3),
     }), flush=True)
     return 0 if ok else 1

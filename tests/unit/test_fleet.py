@@ -150,6 +150,10 @@ class FakeEngine:
             "queue_depth": depth,
             "active_slots": 0,
             "num_slots": 4,
+            # Sharded-serving schema: each fake is a 2-chip slice, so
+            # fleet.health()'s total_chips aggregation is observable.
+            "slice_shape": (2, 1),
+            "slice_chips": 2,
             "orphaned_dispatches": 0,
             "last_dispatch_age_s": None,
         }
@@ -231,6 +235,22 @@ class TestRouterPolicy:
 
 
 class TestFleetRouting:
+    def test_health_composes_slices_not_chips(self):
+        """The fleet is N slices: health() sums each replica's
+        slice_chips (2-chip fakes here) into total_chips, while the
+        router's load signal stays request-counting — a wider slice is
+        not a lighter replica."""
+        engines = [FakeEngine("a"), FakeEngine("b")]
+        fleet = Fleet(_Factory(engines), _quiet_config(min_replicas=2))
+        try:
+            health = fleet.health()
+            assert health["total_chips"] == 4
+            for snap in health["replicas"]:
+                assert snap["slice_chips"] == 2
+                assert Replica.load_of(snap) == 0  # unchanged load math
+        finally:
+            fleet.close()
+
     def test_routes_to_least_loaded_replica(self):
         busy = FakeEngine("busy", auto=False)
         idle = FakeEngine("idle")

@@ -125,6 +125,57 @@ class TestPlanner:
         assert mesh.devices.size == 8
 
 
+class TestServeLayoutPlanner:
+    """plan_serve_layout: the sharded-serving partition picker (one
+    replica = one TP(xSP) slice, KV sharded by head)."""
+
+    def test_no_budget_uses_the_whole_slice(self):
+        layout = planner.plan_serve_layout(num_heads=8, num_devices=8)
+        assert (layout.tp, layout.sp) == (8, 1)
+        assert layout.num_chips == 8
+
+    def test_tp_must_divide_heads(self):
+        # 6 heads on 4 devices: tp=4 would split a head, so the widest
+        # head-granular degree is 3.
+        layout = planner.plan_serve_layout(num_heads=6, num_devices=4)
+        assert layout.tp == 3
+
+    def test_budget_picks_narrowest_fitting_tp(self):
+        # 100 bytes of params+kv total; 30 bytes/chip fits at tp=4
+        # (25/chip) but not tp=2 (50/chip) — and the planner must not
+        # overshoot to tp=8 just because it fits even better.
+        layout = planner.plan_serve_layout(
+            num_heads=8, num_devices=8, param_bytes=60, kv_bytes=40,
+            hbm_bytes_per_chip=30,
+        )
+        assert layout.tp == 4
+        assert layout.param_bytes_per_chip == 15
+        assert layout.kv_bytes_per_chip == 10
+
+    def test_budget_unfittable_raises_with_numbers(self):
+        with pytest.raises(ValueError) as err:
+            planner.plan_serve_layout(
+                num_heads=4, num_devices=2, param_bytes=1000,
+                kv_bytes=1000, hbm_bytes_per_chip=10,
+            )
+        message = str(err.value)
+        assert "tp=2" in message and "hbm_bytes_per_chip=10" in message
+
+    def test_mesh_spec_builds_a_real_slice(self):
+        layout = planner.plan_serve_layout(num_heads=4, num_devices=2)
+        mesh = layout.mesh_spec().build(jax.devices()[:2])
+        assert mesh.devices.size == 2
+        assert mesh.shape["tp"] == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="num_heads"):
+            planner.plan_serve_layout(num_heads=0, num_devices=2)
+        with pytest.raises(ValueError, match="num_devices"):
+            planner.plan_serve_layout(num_heads=2, num_devices=0)
+        with pytest.raises(ValueError, match="sp"):
+            planner.plan_serve_layout(num_heads=2, num_devices=2, sp=4)
+
+
 class TestShardingRules:
     def test_default_rules_specs(self):
         r = parallel.DEFAULT_RULES

@@ -170,6 +170,38 @@ class TestTpuV2Pins:
             assert body["labels"]["cloud_tpu_replica"] == str(i)
             assert body["labels"]["cloud_tpu_job"] == "fleet"
             assert body["labels"]["team"] == "x"
+        # Slice topology (ISSUE 11): the wire format records each
+        # replica's worker count, chip count, and coordinator explicitly
+        # — single-chip fleets carry the same schema with workers=1.
+        topo = request["slice_topology"]
+        assert topo["workers_per_replica"] == 1  # v5litepod-8: one host
+        assert topo["chips_per_replica"] == plan.chips_per_slice == 8
+        assert sorted(topo["coordinators"]) == sorted(request["nodes"])
+        for node_id, coordinator in topo["coordinators"].items():
+            assert coordinator == f"{node_id}-w0:8476"
+
+    def test_serve_fleet_multi_host_slice_topology(self):
+        """A replica spanning a MULTI-HOST slice (sharded serving): the
+        node body asks for the full worker count under its own
+        coordinator, and the slice_topology block says so."""
+        cfg = machine_config.COMMON_MACHINE_CONFIGS["TPU_V5E_32"]
+        plan = planner.plan_mesh(chief_config=cfg)
+        request = deploy.build_serve_fleet_request(
+            "img", cfg, 2, plan, job_id="pods",
+        )
+        topo = request["slice_topology"]
+        assert topo["workers_per_replica"] == plan.hosts_per_slice > 1
+        assert topo["chips_per_replica"] == plan.chips_per_slice
+        for node_id, body in request["nodes"].items():
+            validate(TPU_SCHEMA, "Node", body)
+            script = body["metadata"]["startup-script"]
+            # Every host of the slice dials the REPLICA's coordinator
+            # and the process count covers the whole slice (the exact
+            # env-var spelling the bootstrap consumes).
+            assert topo["coordinators"][node_id] in script
+            assert (
+                f"CLOUD_TPU_NUM_PROCESSES={plan.hosts_per_slice}" in script
+            )
 
     def test_serve_fleet_rejects_empty_fleet(self):
         plan = planner.plan_mesh(chief_config=TPU)
