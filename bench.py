@@ -120,6 +120,25 @@ SERVE_TP_TIMEOUT_S = float(
     os.environ.get("CLOUD_TPU_BENCH_SERVE_TP_TIMEOUT", 240)
 )
 
+#: Speculative-decoding probe: the churn workload through a
+#: draft-and-verify engine, twice — once with a SHARED-WEIGHTS draft
+#: (same architecture and params as the target: acceptance must read
+#: ~100%, a self-check that the verify path, not luck, produces the
+#: numbers) and once with a genuinely smaller draft (fewer layers,
+#: fresh init) next to the identical non-speculative run.  All three
+#: runs serve the SAME prompts, so serve_spec_vs_nonspec_speedup is a
+#: like-for-like ratio; a token mismatch between the speculative and
+#: non-speculative runs zeroes the rate metrics (parity-gated like the
+#: serve_tp probe — never publish a rate for wrong tokens).  On a CPU
+#: rig the speedup is a dispatch-overhead trend number (the draft costs
+#: real time and nothing is memory-bound); a TPU endpoint publishes the
+#: real decode-lever claim.
+SERVE_SPEC_REQUESTS = 12
+SERVE_SPEC_PROMPT_BUCKET = 64
+SERVE_SPEC_NEW_TOKENS = 32
+SERVE_SPEC_K = 4
+SERVE_SPEC_DRAFT_LAYERS = 3
+
 #: Fleet probe (cloud_tpu.fleet): the same churn workload through TWO
 #: engine replicas behind the health-aware router, so what the fleet
 #: layer adds (routing overhead) or buys (parallel replicas) is a
@@ -141,8 +160,13 @@ RECORDED_BASELINE_STEPS_PER_SEC = 162.74
 #: first (even tiny) compile on a slow rig can exceed 75 s without the
 #: tunnel being dead, and a wrongly-failed probe costs a whole backoff
 #: cycle.  The probe workload itself also shrank (64x64 matmuls, two
-#: chain links) — the probe proves liveness, not throughput.
-PROBE_TIMEOUT_S = float(os.environ.get("CLOUD_TPU_BENCH_PROBE_TIMEOUT", 150))
+#: chain links) — the probe proves liveness, not throughput.  Raised
+#: again 150 -> 240 for r07: the probe workload is now provably
+#: negligible (32x32, PR 10), so any remaining probe timeout IS
+#: import+first-compile cost — give it headroom rather than burn a
+#: backoff cycle per false negative (the attempt-anyway escape after 2
+#: straight failures still bounds the worst case).
+PROBE_TIMEOUT_S = float(os.environ.get("CLOUD_TPU_BENCH_PROBE_TIMEOUT", 240))
 #: Per-attempt wall-clock budget.  First TPU compile on this endpoint is
 #: ~20-40 s per program; the headline needs just one compile and prints
 #: within ~1-2 min of child start — the rest of the budget is context
@@ -829,6 +853,123 @@ def _measure_serving_prefix(extras):
     )
 
 
+def _measure_serving_spec(extras):
+    """Speculative-decoding probe (constants block above): the same
+    staggered churn through a non-speculative engine, a smaller-draft
+    speculative engine, and a shared-weights speculative engine.  Emits
+    ``serve_spec_accepted_tokens_per_sec`` (committed tokens per
+    wall-clock second with the real draft),
+    ``serve_spec_acceptance_rate`` (committed draft tokens / proposed),
+    ``serve_spec_vs_nonspec_speedup`` (same prompts, same engine knobs,
+    only the draft differs), and
+    ``serve_spec_selfcheck_acceptance_rate`` — the shared-weights run,
+    which must read ~1.0 (budget truncation at window tails shaves a
+    little) or the verify path is broken.  Parity-gated: any token
+    mismatch vs the non-speculative run zeroes the rate metrics and
+    reports the mismatch count instead of publishing a rate for wrong
+    tokens.
+    """
+    import jax
+    import numpy as np
+
+    from cloud_tpu.models import transformer
+    from cloud_tpu.serving import DraftConfig, ServeConfig, ServingEngine
+    from cloud_tpu.utils.benchmarking import decode_setup
+
+    cfg, params, _, _ = decode_setup(
+        batch_size=SERVE_MAX_BATCH, prompt_len=SERVE_SPEC_PROMPT_BUCKET
+    )
+    draft_cfg = cfg.scaled(num_layers=SERVE_SPEC_DRAFT_LAYERS)
+    draft_params = jax.device_put(
+        transformer.init(jax.random.PRNGKey(5), draft_cfg)
+    )
+    rng = np.random.default_rng(6)
+    lengths = rng.integers(
+        8, SERVE_SPEC_PROMPT_BUCKET + 1, SERVE_SPEC_REQUESTS
+    )
+    budgets = rng.integers(
+        SERVE_SPEC_NEW_TOKENS // 2, SERVE_SPEC_NEW_TOKENS + 1,
+        SERVE_SPEC_REQUESTS,
+    )
+    prompts = [
+        rng.integers(1, cfg.vocab_size, n).astype(np.int32) for n in lengths
+    ]
+
+    def churn(draft):
+        serve = ServeConfig(
+            max_new_tokens=SERVE_SPEC_NEW_TOKENS,
+            prompt_buckets=(SERVE_SPEC_PROMPT_BUCKET,),
+            num_slots=SERVE_MAX_BATCH,
+            chunk_tokens=SERVE_CHURN_CHUNK,
+            draft=draft,
+            warmup=True,
+        )
+        with ServingEngine(params, cfg, serve, mesh=None) as engine:
+            engine.wait_ready()
+            engine.submit(prompts[0]).result()  # absorb first dispatch
+            warm = engine.stats()
+            start = time.perf_counter()
+            futures = []
+            for i, prompt in enumerate(prompts):
+                futures.append(
+                    engine.submit(prompt, max_new_tokens=int(budgets[i]))
+                )
+                if (i + 1) % (SERVE_MAX_BATCH // 2) == 0:
+                    time.sleep(0.02)  # staggered waves, not one burst
+            results = [f.result() for f in futures]
+            wall = time.perf_counter() - start
+            stats = engine.stats()
+        tokens = sum(r.num_generated for r in results)
+        delta = {
+            key: stats[key] - warm[key]
+            for key in ("spec_accepted", "spec_proposed", "spec_chunks")
+        }
+        return results, tokens / wall if wall else 0.0, delta
+
+    nonspec_results, nonspec_rate, _ = churn(None)
+    spec_results, spec_rate, spec_delta = churn(DraftConfig(
+        config=draft_cfg, params=draft_params, spec_k=SERVE_SPEC_K,
+    ))
+    self_results, _, self_delta = churn(DraftConfig(
+        config=cfg, params=params, spec_k=SERVE_SPEC_K,
+    ))
+
+    mismatches = sum(
+        1 for spec_r, base_r in zip(spec_results, nonspec_results)
+        if not np.array_equal(spec_r.tokens, base_r.tokens)
+    ) + sum(
+        1 for self_r, base_r in zip(self_results, nonspec_results)
+        if not np.array_equal(self_r.tokens, base_r.tokens)
+    )
+    ok = mismatches == 0
+
+    def rate(delta):
+        return (
+            delta["spec_accepted"] / delta["spec_proposed"]
+            if delta["spec_proposed"] else 0.0
+        )
+
+    extras["serve_spec_accepted_tokens_per_sec"] = round(
+        spec_rate if ok else 0.0, 1
+    )
+    extras["serve_spec_acceptance_rate"] = round(
+        rate(spec_delta) if ok else 0.0, 3
+    )
+    extras["serve_spec_vs_nonspec_speedup"] = round(
+        spec_rate / nonspec_rate if ok and nonspec_rate else 0.0, 3
+    )
+    extras["serve_spec_selfcheck_acceptance_rate"] = round(
+        rate(self_delta) if ok else 0.0, 3
+    )
+    extras["serve_spec_nonspec_tokens_per_sec"] = round(nonspec_rate, 1)
+    extras["serve_spec_parity_mismatches"] = mismatches
+    extras["serve_spec_config"] = (
+        f"SMALL draft{SERVE_SPEC_DRAFT_LAYERS}L k{SERVE_SPEC_K} "
+        f"slots{SERVE_MAX_BATCH} bucket{SERVE_SPEC_PROMPT_BUCKET} "
+        f"new<= {SERVE_SPEC_NEW_TOKENS} n{SERVE_SPEC_REQUESTS} staggered"
+    )
+
+
 def _serve_tp_main() -> int:
     """The ``--serve-tp`` child: sharded-vs-single-chip serving churn.
 
@@ -1152,6 +1293,7 @@ def _child_main() -> int:
         (_measure_serving, "serving"),
         (_measure_serving_churn, "serving_churn"),
         (_measure_serving_prefix, "serving_prefix"),
+        (_measure_serving_spec, "serving_spec"),
         (_measure_serving_tp, "serving_tp"),
         (_measure_fleet, "fleet"),
         (_measure_durability, "durability"),

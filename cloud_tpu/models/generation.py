@@ -1034,6 +1034,258 @@ def finalize_slot_program(
                      config, sample=sample, rng=rng)
 
 
+# --------------------------------------------------------------------------
+# Speculative decoding: draft-and-verify on the slot grid.  Decode is one
+# target-model dispatch per token per slot; at batch occupancy the per-step
+# KV re-read dominates.  Speculation trades k cheap DRAFT-model steps for
+# ONE wide target dispatch: :func:`draft_chunk_program` proposes a
+# ``spec_k``-token window per active slot with a small draft model over
+# its own slot cache, :func:`verify_chunk_program` scores every window
+# position in a single target forward (the chunked-prefill attention
+# shape), commits the greedily-accepted prefix — KV, ``pos``, emissions —
+# and rewinds past the first mismatch so rejected cache rows are simply
+# overwritten by the next window.  Greedy acceptance keeps outputs
+# token-identical to the sequential path: every committed emission is the
+# TARGET's own argmax over the same context bytes, the draft only decides
+# how many of them one dispatch gets to commit.
+
+
+def draft_chunk_program(
+    params,
+    cache,
+    state,
+    config: transformer.TransformerConfig,
+    *,
+    spec_k: int,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+):
+    """Propose a ``spec_k``-token verify window for every slot with the
+    DRAFT model: ``spec_k`` greedy single-token steps over the draft's
+    own slot cache (one ``lax.scan`` — static shapes, ONE compile for
+    the engine's life).
+
+    Returns ``(cache, window)`` with ``window`` [num_slots, spec_k]:
+    column 0 is each slot's carried token (``state["tok"]``, sampled
+    but not yet consumed), columns 1.. the draft's greedy proposals.
+    Each step writes its consumed token's k/v into the draft cache row
+    (inactive slots' writes suppressed exactly like
+    :func:`decode_chunk_program`), so after the verify commits an
+    accepted prefix the draft cache already holds KV for every
+    committed position — the next proposal round needs no catch-up
+    forward.  The final step's proposal is discarded (that step exists
+    to write the last window token's draft KV).  Draft sampling is
+    plain argmax with none of the target's eos/min-token gating:
+    proposals only steer ACCEPTANCE, never emissions, so a draft that
+    proposes a masked token merely loses acceptance — it cannot change
+    the output.  ``state`` is read-only here; the verify owns every
+    state transition.
+    """
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+    s = cache["k"].shape[2]
+    active = state["active"]
+
+    def step(carry, _):
+        cache, tok, pos = carry
+        write_pos = jnp.where(active, pos, jnp.int32(s))
+        cache, logits = _decode_step(
+            params, cache, tok, pos, config, rules, mesh,
+            write_pos=write_pos,
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt, pos + 1), tok
+
+    (cache, _, _), consumed = jax.lax.scan(
+        step, (cache, state["tok"], state["pos"]), None, length=spec_k
+    )
+    return cache, consumed.T  # [num_slots, spec_k]
+
+
+def verify_chunk_program(
+    params,
+    cache,
+    state,
+    window: jnp.ndarray,
+    config: transformer.TransformerConfig,
+    *,
+    sample: SampleConfig = SampleConfig(temperature=0.0),
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+):
+    """Score a draft window for every slot in ONE target forward and
+    commit the accepted prefix.
+
+    ``window`` is [num_slots, spec_k]: column 0 each slot's carried
+    token, columns 1.. the draft proposals (what
+    :func:`draft_chunk_program` returns).  The forward is the
+    chunked-prefill shape batched over slots: each layer writes the
+    window's k/v at per-slot positions ``pos..pos+k-1`` and attends
+    ``chunk_causal`` over the whole row, so the logits after window
+    position i are bit-for-bit what ``_decode_step`` would produce
+    having consumed ``window[:, :i+1]`` one token at a time.  Greedy
+    target emissions ``g_i`` then gate acceptance: draft token
+    ``window[:, i]`` is accepted while it equals ``g_{i-1}``, and the
+    committed emissions are ``g_0..g_a`` — the first mismatch
+    position's own target token is itself a correct emission, so every
+    dispatch commits at least one token per active slot (an
+    all-rejected window degenerates to the non-speculative step).
+    Emissions truncate at eos and the slot's ``remaining`` budget with
+    the sequential path's exact semantics; ``pos`` advances only by the
+    commit count, which IS the rewind: cache rows written past the
+    first mismatch sit beyond ``pos`` where attention masks them
+    (key j valid iff ``j < pos``) and the next window overwrites them
+    before they could ever become valid — the same staleness invariant
+    as slot reuse.
+
+    Greedy-only (temperature 0, no repetition penalty — lossless
+    speculative SAMPLING needs rejection resampling, which this grid
+    does not do); ``eos_id``/``min_new_tokens`` are supported.  Returns
+    ``(cache, state, toks, valid)`` shaped exactly like
+    :func:`decode_chunk_program` — the serving engine's emission
+    handling cannot tell the two apart.
+    """
+    if sample.temperature != 0.0 or sample.repetition_penalty != 1.0:
+        raise ValueError(
+            "speculative decoding requires greedy sampling "
+            "(temperature=0, repetition_penalty=1); token-identical "
+            "non-greedy speculation needs rejection resampling"
+        )
+    num_slots, k = window.shape
+    window = window.astype(jnp.int32)
+    active = state["active"]
+    pos = state["pos"]
+    s = cache["k"].shape[2]
+    rows = jnp.arange(num_slots)
+    positions = pos[:, None] + jnp.arange(k)[None, :]  # [slots, k]
+    quantized = "k_scale" in cache
+
+    x = layers.embedding_apply(params["embed"], window, dtype=config.dtype,
+                               rules=rules, mesh=mesh)
+    x = x * math.sqrt(config.dim)
+    x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules,
+                         mesh=mesh)
+    # Inactive slots write NOWHERE (out-of-range -> drop-mode scatter):
+    # same frozen-position protection as decode_chunk_program — a slot
+    # mid-chunked-prefill holds real prompt KV at pos.
+    write_idx = jnp.where(active[:, None], positions, jnp.int32(s))
+
+    def layer_body(x, layer_slice):
+        layer_params, cache_l = layer_slice
+        y = layers.rmsnorm_apply(layer_params["ln1"], x)
+        q, k_new, v_new = transformer.qkv_project(
+            layer_params["att"], y, positions, config
+        )
+        updates = _kv_leaf_updates(k_new, v_new, config, quantized)
+        cache_l = dict(cache_l)
+        for name, val in updates.items():
+            cache_l[name] = cache_l[name].at[rows[:, None], write_idx].set(
+                val, mode="drop"
+            )
+        attended = _cache_attention(q, cache_l, pos + 1, chunk_causal=True)
+        att_out = layers.dense_apply(
+            layer_params["att"]["out"], attended.reshape(num_slots, k, -1)
+        )
+        x = x + att_out
+        y = layers.rmsnorm_apply(layer_params["ln2"], x)
+        x = x + _mlp(layer_params, y, config, rules)
+        x = shard_constraint(x, "batch", "seq", "act_embed", rules=rules,
+                             mesh=mesh)
+        return x, cache_l
+
+    x, cache = jax.lax.scan(layer_body, x, (params["layers"], cache))
+    logits = _final_logits(params, x, config)  # [slots, k, V]
+    # Sampling boundary reshard (see _prefill_forward): once per forward.
+    logits = shard_constraint(logits, "batch", None, None, rules=rules,
+                              mesh=mesh)
+
+    # Greedy emission per window position, with the sequential path's
+    # eos allow gate: emission i is global emission (emitted + i + 1),
+    # sampled when the slot's emitted count reads emitted + i.
+    need_min = sample.eos_id is not None and sample.min_new_tokens > 0
+    allow = None
+    if need_min:
+        allow = (
+            state["emitted"][:, None] + jnp.arange(k)[None, :]
+            >= sample.min_new_tokens
+        ).reshape(num_slots * k)
+    g = sample_logits(
+        jax.random.PRNGKey(0), logits.reshape(num_slots * k, -1), sample,
+        allow_eos=allow,
+    ).astype(jnp.int32).reshape(num_slots, k)
+
+    # Acceptance: emission i commits iff every draft token before it
+    # matched the target's greedy choice — a leading-prefix property,
+    # like every other gate below, so the final cumprod is belt and
+    # braces, not a semantic.
+    ones = jnp.ones((num_slots, 1), jnp.int32)
+    if k > 1:
+        match = (window[:, 1:] == g[:, :-1]).astype(jnp.int32)
+        emit_ok = jnp.concatenate(
+            [ones, jnp.cumprod(match, axis=1)], axis=1
+        ).astype(bool)
+    else:
+        emit_ok = ones.astype(bool)
+    emit_ok &= jnp.arange(k)[None, :] < state["remaining"][:, None]
+    if sample.eos_id is not None:
+        is_eos = (g == sample.eos_id).astype(jnp.int32)
+        prior_eos = jnp.cumsum(is_eos, axis=1) - is_eos
+        emit_ok &= prior_eos == 0  # the eos itself emits; nothing after
+    emit_ok &= active[:, None]
+    valid = jnp.cumprod(emit_ok.astype(jnp.int32), axis=1).astype(bool)
+
+    toks = jnp.where(valid, g, jnp.int32(sample.pad_id))
+    n = valid.sum(axis=1).astype(jnp.int32)  # commit count; 0 if inactive
+    last_tok = jnp.take_along_axis(
+        toks, jnp.maximum(n - 1, 0)[:, None], axis=1
+    )[:, 0]
+    new_state = dict(state)
+    new_state["pos"] = pos + n
+    new_state["remaining"] = state["remaining"] - n
+    new_state["emitted"] = state["emitted"] + n
+    finished = new_state["remaining"] <= 0
+    if sample.eos_id is not None:
+        finished = finished | ((n > 0) & (last_tok == sample.eos_id))
+    new_state["active"] = active & ~finished
+    new_state["tok"] = jnp.where(n > 0, last_tok, state["tok"])
+    return cache, new_state, toks, valid
+
+
+def draft_prefill_slot_program(
+    params,
+    cache,
+    prompt_tokens: jnp.ndarray,
+    prompt_len,
+    slot,
+    config: transformer.TransformerConfig,
+    *,
+    rules: ShardingRules = DEFAULT_RULES,
+    mesh=None,
+):
+    """Prefill one request's prompt into the DRAFT model's slot cache
+    row — the draft-side twin of :func:`insert_slot_program` minus the
+    sampling (``tok0`` always comes from the TARGET's prefill logits;
+    the draft only needs the prompt KV so its first proposal round can
+    attend over real context).  Always a one-shot full-prompt forward,
+    whatever the target side did: the draft is small by construction,
+    so target prefix-cache hits and chunked prefills compose freely —
+    the target reuses cached blocks while the draft just re-prefills
+    from the prompt.  One program per prompt bucket
+    (``prompt_len``/``slot`` traced).  Returns the cache.
+    """
+    t_prompt = prompt_tokens.shape[1]
+    prompt_len = jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1, t_prompt)
+    lens = jnp.reshape(prompt_len, (1,))
+    k_pref, v_pref, _ = _prefill_forward(
+        params, prompt_tokens, lens, config, rules, mesh
+    )
+    slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.int32(0)
+    return _write_prefill(
+        cache, k_pref, v_pref, (zero, slot, zero, zero, zero), config
+    )
+
+
 def check_inference_supported(config, rules, mesh, what: str = "inference"):
     """Public guard for callers that bypass :func:`generate`'s own checks
     (the serving engine validates once at startup, then dispatches the

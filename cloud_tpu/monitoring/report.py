@@ -22,7 +22,12 @@ chunked-prefill timelines (``serve/prefix_lookup`` /
 ``serve/prefill_chunk`` spans) get hit rate, hit tokens, prefill-chunk
 count, and decode-stall attribution (one interleaved prefill chunk is
 exactly the stall a decode chunk can see, so the max chunk duration is
-the worst stall of the run).
+the worst stall of the run).  Speculative-decoding timelines
+(``serve/draft`` / ``serve/verify`` spans) get a line with the verify
+dispatch count, the draft-token acceptance rate (from the
+``accepted``/``proposed`` attributes the scheduler stamps per verify),
+and the draft-vs-verify wall-clock split — the numbers ``spec_k`` is
+tuned against, printed next to the occupancy line.
 
 Timelines with ``fleet/*`` spans (the ``cloud_tpu.fleet`` layer) get a
 **fleet** section: per-replica routed-request counts with mean
@@ -109,7 +114,7 @@ class TraceReport:
     #: mode chunk); anything else under ``serve/`` rides along.
     _SERVE_ORDER = (
         "serve/queue_wait", "serve/batch_form", "serve/prefill",
-        "serve/decode", "serve/chunk",
+        "serve/decode", "serve/chunk", "serve/draft", "serve/verify",
     )
 
     def continuous_summary(self) -> Optional[Dict[str, float]]:
@@ -201,6 +206,47 @@ class TraceReport:
             "max_decode_stall_seconds": (
                 max(chunk_durs) if chunk_durs else None
             ),
+        }
+
+    def spec_summary(self) -> Optional[Dict[str, object]]:
+        """Aggregate the speculative-decoding spans.
+
+        ``serve/verify`` spans carry ``tokens``/``accepted``/``proposed``
+        attributes (the scheduler stamps them per verify dispatch), so
+        the acceptance rate is committed-draft tokens over proposed
+        ones; ``draft_seconds`` sums the ``serve/draft`` +
+        ``serve/draft_prefill`` spans and ``verify_seconds`` the verify
+        spans — the draft/verify wall-clock split the spec_k knob is
+        tuned against.  None when the timeline has no speculative spans
+        (draft off, batch mode, or a non-serving trace).
+        """
+        verify_durs: List[float] = []
+        draft_durs: List[float] = []
+        counts = {"tokens": 0, "accepted": 0, "proposed": 0}
+        for event in self.events:
+            name = event.get("name", "")
+            if name == "serve/verify":
+                verify_durs.append(event["dur"] / 1e6)
+                args = event.get("args") or {}
+                for key in counts:
+                    value = args.get(key)
+                    if isinstance(value, (int, float)):
+                        counts[key] += int(value)
+            elif name in ("serve/draft", "serve/draft_prefill"):
+                draft_durs.append(event["dur"] / 1e6)
+        if not verify_durs and not draft_durs:
+            return None
+        return {
+            "verify_dispatches": len(verify_durs),
+            "tokens": counts["tokens"],
+            "accepted": counts["accepted"],
+            "proposed": counts["proposed"],
+            "acceptance_rate": (
+                counts["accepted"] / counts["proposed"]
+                if counts["proposed"] else None
+            ),
+            "draft_seconds": sum(draft_durs),
+            "verify_seconds": sum(verify_durs),
         }
 
     def serving_rows(self, rows: Optional[List[Dict[str, float]]] = None
@@ -509,6 +555,21 @@ class TraceReport:
                 parts.append(f"{continuous['tokens']:.0f} tokens")
             lines.append("")
             lines.append("continuous batching: " + " · ".join(parts))
+        spec = self.spec_summary()
+        if spec:
+            parts = [f"{spec['verify_dispatches']} verify dispatches"]
+            if spec["acceptance_rate"] is not None:
+                parts.append(
+                    f"accept rate {spec['acceptance_rate']:.1%}"
+                )
+            if spec["tokens"]:
+                parts.append(f"{spec['tokens']} tokens committed")
+            parts.append(
+                f"draft {_fmt_s(spec['draft_seconds'])} / verify "
+                f"{_fmt_s(spec['verify_seconds'])}"
+            )
+            lines.append("")
+            lines.append("speculative decoding: " + " · ".join(parts))
         prefix = self.prefix_summary()
         if prefix:
             parts = []

@@ -143,6 +143,10 @@ class ServeLayout:
     description: str
     param_bytes_per_chip: int = 0
     kv_bytes_per_chip: int = 0
+    #: Speculative-decoding draft footprint (params + draft slot KV),
+    #: budgeted REPLICATED per chip — the conservative bound: the engine
+    #: head-shards the draft only when ``tp`` divides its head count.
+    draft_bytes_per_chip: int = 0
 
     @property
     def num_chips(self) -> int:
@@ -164,6 +168,7 @@ def plan_serve_layout(
     num_devices: int,
     param_bytes: int = 0,
     kv_bytes: int = 0,
+    draft_bytes: int = 0,
     hbm_bytes_per_chip: Optional[int] = None,
     sp: int = 1,
 ) -> ServeLayout:
@@ -179,7 +184,10 @@ def plan_serve_layout(
     the slice (``tp * sp <= num_devices``).  Without a budget the
     largest candidate wins: use the whole slice for per-request speed.
     With ``hbm_bytes_per_chip``, the SMALLEST candidate whose estimated
-    per-chip bytes (params + KV, both ~1/tp) fit wins — sharding no
+    per-chip bytes (params + KV, both ~1/tp, plus the whole
+    ``draft_bytes`` term — a speculative-decoding draft model's params +
+    draft slot KV, budgeted replicated since the engine only head-shards
+    a draft whose head count ``tp`` divides) fit wins — sharding no
     wider than memory requires leaves the remaining chips for more
     replicas, which is the fleet's business, not the slice's.  Raises
     ``ValueError`` (naming every number involved) when even the widest
@@ -207,7 +215,7 @@ def plan_serve_layout(
     else:
         fitting = [
             t for t in candidates
-            if sum(per_chip(t)) <= hbm_bytes_per_chip
+            if sum(per_chip(t)) + draft_bytes <= hbm_bytes_per_chip
         ]
         if not fitting:
             widest = candidates[-1]
@@ -216,9 +224,13 @@ def plan_serve_layout(
                 f"{hbm_bytes_per_chip}: even tp={widest} (the widest "
                 f"divisor of num_heads={num_heads} within "
                 f"{num_devices} device(s), sp={sp}) needs "
-                f"{sum(per_chip(widest))} bytes/chip "
-                f"(params {param_bytes} + kv {kv_bytes} total). "
-                "Shrink the model/cache or grow the slice."
+                f"{sum(per_chip(widest)) + draft_bytes} bytes/chip "
+                f"(params {param_bytes} + kv {kv_bytes} total"
+                + (
+                    f" + draft {draft_bytes} replicated"
+                    if draft_bytes else ""
+                )
+                + "). Shrink the model/cache/draft or grow the slice."
             )
         tp = fitting[0]
     p_chip, k_chip = per_chip(tp)
@@ -227,14 +239,17 @@ def plan_serve_layout(
         + (f" x sp={sp}" if sp > 1 else "")
         + f" ({num_heads} heads -> {num_heads // tp}/chip"
         + (
-            f", ~{(p_chip + k_chip) >> 20} MiB/chip"
-            if param_bytes or kv_bytes else ""
+            f", ~{(p_chip + k_chip + draft_bytes) >> 20} MiB/chip"
+            if param_bytes or kv_bytes or draft_bytes else ""
         )
+        + (f", draft ~{draft_bytes >> 20} MiB replicated"
+           if draft_bytes else "")
         + ")"
     )
     return ServeLayout(
         tp=tp, sp=sp, description=description,
         param_bytes_per_chip=p_chip, kv_bytes_per_chip=k_chip,
+        draft_bytes_per_chip=draft_bytes,
     )
 
 

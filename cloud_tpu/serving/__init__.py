@@ -16,6 +16,7 @@ measured against.  See ``docs/serving.md`` and
 from cloud_tpu.serving.engine import (
     DeadlineExceededError,
     DispatchTimeoutError,
+    DraftConfig,
     EngineClosedError,
     QueueFullError,
     ServeConfig,
@@ -29,6 +30,7 @@ from cloud_tpu.serving.prefix_cache import PrefixCacheManager, PrefixHit
 __all__ = [
     "DeadlineExceededError",
     "DispatchTimeoutError",
+    "DraftConfig",
     "EngineClosedError",
     "PrefixCacheManager",
     "PrefixHit",

@@ -52,6 +52,20 @@ with two schedulers sharing one submit/future/admission surface:
   error).  Unset / ``(1, 1)`` keeps the single-chip path
   byte-identical, and greedy outputs on any slice are token-identical
   to single-chip ``generate()`` — docs/serving.md "Sharded serving".
+* **Speculative decoding** (``draft=DraftConfig(...)``, continuous
+  mode) — draft-and-verify on the slot grid: a small draft model
+  proposes a ``spec_k``-token window per active slot
+  (``generation.draft_chunk_program`` over the draft's own slot cache),
+  and the target model scores every window position in ONE chunked
+  dispatch (``generation.verify_chunk_program``), committing the
+  greedily-accepted prefix and rewinding past the first mismatch.
+  Greedy outputs stay token-identical to the non-speculative engine —
+  every committed token is the target's own argmax; the draft only
+  decides how many of them one dispatch commits — so the win metric is
+  accepted-tokens/sec with target-dispatches-per-token < 1.
+  ``draft=None`` (default) is byte-identical to the non-speculative
+  path; ``spec_k=1`` is a pure-overhead test knob.  ``health()`` and
+  ``stats()`` report a rolling/cumulative acceptance rate.
 * **Dynamic batching** (``scheduler="batch"``, the PR 4 path) — the
   scheduler groups waiting requests by prompt-length bucket, pads each
   group to a static ``(bucket_len, batch_size)`` grid point, and
@@ -144,6 +158,41 @@ class DispatchTimeoutError(RuntimeError):
 
 
 @dataclasses.dataclass(frozen=True)
+class DraftConfig:
+    """The draft half of draft-and-verify speculative decoding.
+
+    ``config`` is any ``models.transformer.TransformerConfig`` —
+    typically fewer layers / narrower than the target (its vocabulary
+    must match the target's: acceptance compares token ids); ``params``
+    the draft model's weights.  ``spec_k`` is the verify-window width:
+    the tokens the TARGET consumes — and can commit — per verify
+    dispatch; the draft proposes ``spec_k - 1`` of them.  ``spec_k=1``
+    degenerates to the non-speculative schedule with the draft as pure
+    overhead (the parity/overhead test knob).  Speculation is
+    greedy-only: the engine rejects non-zero temperature and
+    repetition penalties with typed errors (token-identical non-greedy
+    speculation needs rejection resampling, which the grid does not
+    do).
+    """
+
+    config: object
+    #: repr-suppressed: a params pytree in a logged config would dump
+    #: whole weight arrays.
+    params: object = dataclasses.field(repr=False, default=None)
+    spec_k: int = 4
+
+    def __post_init__(self):
+        if self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
+        if self.params is None:
+            raise ValueError(
+                "DraftConfig needs the draft model's params — without "
+                "them the first proposal dispatch would die deep in the "
+                "scheduler thread instead of here"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Engine knobs (all static — they define the compiled-program grid).
 
@@ -197,6 +246,11 @@ class ServeConfig:
     #: instead of one full prefill.  None (default) keeps the one-shot
     #: insert prefill — the compatibility default.
     prefill_chunk_tokens: Optional[int] = None
+    #: Draft-and-verify speculative decoding (continuous mode): arm with
+    #: ``DraftConfig(config=..., params=..., spec_k=...)``.  ``None``
+    #: (default) keeps the one-dispatch-per-token decode path
+    #: byte-identical.  Greedy-only (module docstring).
+    draft: Optional[DraftConfig] = None
     #: Sampling config shared by every request (static: it specializes
     #: the compiled decode program).  Default greedy.
     sample: "SampleConfig" = None  # type: ignore[assignment]
@@ -296,6 +350,27 @@ class ServeConfig:
                 "continuous scheduler (slot-grid prefill); the batch "
                 "path has no per-slot cache rows to reuse"
             )
+        if self.draft is not None:
+            if self.scheduler != "continuous":
+                raise ValueError(
+                    "draft= (speculative decoding) needs the continuous "
+                    "scheduler — the verify program is a slot-grid "
+                    "dispatch"
+                )
+            if self.sample.temperature != 0.0:
+                raise ValueError(
+                    "draft= (speculative decoding) requires greedy "
+                    f"sampling; got temperature={self.sample.temperature}"
+                    " (token-identical non-greedy speculation needs "
+                    "rejection resampling)"
+                )
+            if self.sample.repetition_penalty != 1.0:
+                raise ValueError(
+                    "draft= (speculative decoding) does not compose with "
+                    "repetition_penalty: the verify window's emissions "
+                    "would each need the penalty state of the emissions "
+                    "before them"
+                )
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
         if self.flush_deadline_s < 0:
@@ -527,6 +602,12 @@ class ServingEngine:
             "inserts": 0, "retires": 0, "expired": 0, "chunks": 0,
             # Prefix-cache / chunked-prefill counters (0 when disabled).
             "prefill_chunks": 0, "prefix_hits": 0, "prefix_misses": 0,
+            # Speculative-decoding counters (0 when draft=None):
+            # spec_chunks = verify (target) dispatches, spec_emitted =
+            # tokens they committed, spec_proposed/accepted = draft
+            # tokens offered/committed — acceptance is their quotient.
+            "spec_chunks": 0, "spec_emitted": 0,
+            "spec_proposed": 0, "spec_accepted": 0, "draft_prefills": 0,
             # Robustness counters: queue-shed deadlines, watchdog fires.
             "shed": 0, "watchdog_timeouts": 0,
         }
@@ -536,6 +617,8 @@ class ServingEngine:
         )
 
         self._continuous = self.serve_config.scheduler == "continuous"
+        #: Speculative decoding armed (continuous branch may flip it).
+        self._spec = False
         if self._continuous:
             cfg = self.serve_config
             #: Slot cache rows must fit the largest bucket's prompt plus
@@ -615,11 +698,28 @@ class ServingEngine:
             self._finalize_traces = 0
             self._copy_traces = 0
             self._save_traces = 0
+            self._draft_traces = 0
+            self._verify_traces = 0
+            self._draft_prefill_traces = 0
             # Donating the grid through each dispatch keeps the cache
             # update in place; CPU ignores donation with a warning, so
             # only ask for it where the backend honors it.
             self._donate = jax.default_backend() != "cpu"
             self._chunk_step = self._make_chunk_step()
+            #: Speculative decoding (None unless ServeConfig.draft):
+            #: the draft model's own slot cache + its program cells and
+            #: a rolling per-dispatch (accepted, proposed) window for
+            #: health()'s acceptance rate.
+            self._spec = cfg.draft is not None
+            self._draft_cache = None
+            self._draft_step = None
+            self._verify_step = None
+            self._draft_prefill_cells: Dict[int, "compile_cache.AotStep"] = {}
+            self._accept_window: collections.deque = collections.deque(
+                maxlen=64
+            )
+            if self._spec:
+                self._init_draft()
 
         if self.serve_config.warmup:
             self._start_warmup()
@@ -688,6 +788,16 @@ class ServingEngine:
             # the repo's one accounting helper for this).
             from cloud_tpu.training.optimizers import optimizer_state_bytes
 
+            draft_bytes = 0
+            if cfg.draft is not None:
+                # The draft rides every chip (replicated unless its head
+                # count happens to divide tp — budget the worst case):
+                # params plus its own slot KV grid, no prefix pool.
+                draft_bytes = optimizer_state_bytes(cfg.draft.params) + (
+                    self._kv_bytes_estimate(
+                        cfg.draft.config, include_prefix=False
+                    )
+                )
             plan = planner.plan_serve_layout(
                 num_heads=num_heads,
                 num_devices=(
@@ -696,6 +806,7 @@ class ServingEngine:
                 ),
                 param_bytes=optimizer_state_bytes(self.params),
                 kv_bytes=self._kv_bytes_estimate(),
+                draft_bytes=draft_bytes,
                 hbm_bytes_per_chip=cfg.hbm_bytes_per_chip,
             )
             tp, sp = plan.tp, plan.sp
@@ -718,13 +829,16 @@ class ServingEngine:
         self._built_serving_mesh = True
         return (tp, sp), chips
 
-    def _kv_bytes_estimate(self) -> int:
+    def _kv_bytes_estimate(self, model_config=None,
+                           include_prefix: bool = True) -> int:
         """Total KV bytes the engine will allocate (slot grid + prefix
         pool for the continuous scheduler, the largest batch cell
         otherwise) — the planner's auto-layout input, an estimate, not
-        an allocator."""
+        an allocator.  ``model_config`` sizes a different model's cache
+        over the same grid (the speculative draft, which gets no
+        prefix pool — ``include_prefix=False``)."""
         cfg = self.serve_config
-        c = self.config
+        c = model_config if model_config is not None else self.config
         itemsize = 1 if cfg.kv_quant else np.dtype(c.dtype).itemsize
         # Per cached position: k + v across every layer and head (+ the
         # two f32 scale columns when quantized).
@@ -734,7 +848,10 @@ class ServingEngine:
         max_len = cfg.prompt_buckets[-1] + cfg.max_new_tokens
         if cfg.scheduler == "continuous":
             positions = cfg.num_slots * max_len
-            positions += cfg.prefix_cache_blocks * cfg.prefix_block_tokens
+            if include_prefix:
+                positions += (
+                    cfg.prefix_cache_blocks * cfg.prefix_block_tokens
+                )
         else:
             positions = cfg.batch_buckets[-1] * max_len
         return per_pos * positions
@@ -753,6 +870,147 @@ class ServingEngine:
         self.params = jax.device_put(
             self.params, param_shardings(self.mesh, axes, self.rules)
         )
+
+    # -- speculative decoding ----------------------------------------------
+
+    def _init_draft(self) -> None:
+        """Arm draft-and-verify: validate the draft against the target,
+        place its params/cache on the slice, and build the program
+        cells.  The draft head-shards like the target when ``tp``
+        divides its head count; otherwise params and its slot cache
+        replicate across the slice (a draft is small — replication
+        costs HBM the planner's draft term budgets for, and buys the
+        verify path an undisturbed layout)."""
+        import jax
+
+        from cloud_tpu.models import generation
+
+        cfg = self.serve_config
+        dcfg = cfg.draft.config
+        if int(dcfg.vocab_size) != int(self.config.vocab_size):
+            raise ValueError(
+                f"draft vocab_size={dcfg.vocab_size} != target "
+                f"vocab_size={self.config.vocab_size}: acceptance "
+                "compares token ids, so the two models must share a "
+                "vocabulary"
+            )
+        generation.check_inference_supported(
+            dcfg, self.rules, None, "speculative draft"
+        )
+        tp = self._slice_shape[0]
+        self._draft_sharded = (
+            self._slice_chips > 1 and int(dcfg.num_heads) % tp == 0
+        )
+        #: Mesh the draft programs constrain against: the slice when
+        #: head-sharded, None (replicated compute) otherwise.
+        self._draft_mesh = self.mesh if self._draft_sharded else None
+        self._draft_params = cfg.draft.params
+
+        def make_draft_grid():
+            return generation.init_slot_cache(
+                dcfg, cfg.num_slots, self._max_len, rules=self.rules,
+                mesh=self._draft_mesh, kv_quant=cfg.kv_quant,
+            )
+
+        if self._draft_sharded:
+            if self._built_serving_mesh:
+                from cloud_tpu.models import transformer
+                from cloud_tpu.training.train import param_shardings
+
+                axes = transformer.param_logical_axes(dcfg)
+                self._draft_params = jax.device_put(
+                    cfg.draft.params,
+                    param_shardings(self.mesh, axes, self.rules),
+                )
+            self._draft_cache = jax.jit(make_draft_grid)()
+        elif self._slice_chips > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            replicated = NamedSharding(self.mesh, PartitionSpec())
+            self._draft_params = jax.device_put(
+                cfg.draft.params, replicated
+            )
+            self._draft_cache = jax.device_put(make_draft_grid(),
+                                               replicated)
+        else:
+            self._draft_cache = make_draft_grid()
+        self._draft_step = self._make_draft_step()
+        self._verify_step = self._make_verify_step()
+
+    def _make_draft_step(self):
+        """The draft-proposal program: ONE compile serves the engine's
+        life (static spec_k window over the whole grid)."""
+        import jax
+
+        from cloud_tpu.models import generation
+        from cloud_tpu.training import compile_cache
+
+        cfg = self.serve_config
+        dcfg = cfg.draft.config
+
+        def draft_fn(params, cache, state):
+            self._draft_traces += 1
+            return generation.draft_chunk_program(
+                params, cache, state, dcfg, spec_k=cfg.draft.spec_k,
+                rules=self.rules, mesh=self._draft_mesh,
+            )
+
+        donate = (1,) if self._donate else ()
+        return compile_cache.AotStep(
+            jax.jit(draft_fn, donate_argnums=donate),
+            label="serve/draft_chunk",
+        )
+
+    def _make_verify_step(self):
+        """The target's verify program: scores a whole spec_k window per
+        slot in one dispatch and commits the accepted prefix.  ONE
+        compile serves the engine's life."""
+        import jax
+
+        from cloud_tpu.models import generation
+        from cloud_tpu.training import compile_cache
+
+        cfg = self.serve_config
+
+        def verify_fn(params, cache, state, window):
+            self._verify_traces += 1
+            return generation.verify_chunk_program(
+                params, cache, state, window, self.config,
+                sample=cfg.sample, rules=self.rules, mesh=self.mesh,
+            )
+
+        donate = (1, 2) if self._donate else ()
+        return compile_cache.AotStep(
+            jax.jit(verify_fn, donate_argnums=donate),
+            label="serve/verify_chunk",
+        )
+
+    def _draft_prefill_cell(self, bucket_len: int):
+        """The draft-side prompt prefill for one bucket (one executable
+        per bucket, like the insert programs)."""
+        cell = self._draft_prefill_cells.get(bucket_len)
+        if cell is None:
+            import jax
+
+            from cloud_tpu.models import generation
+            from cloud_tpu.training import compile_cache
+
+            dcfg = self.serve_config.draft.config
+
+            def draft_prefill_fn(params, cache, tokens, prompt_len, slot):
+                self._draft_prefill_traces += 1
+                return generation.draft_prefill_slot_program(
+                    params, cache, tokens, prompt_len, slot, dcfg,
+                    rules=self.rules, mesh=self._draft_mesh,
+                )
+
+            donate = (1,) if self._donate else ()
+            cell = compile_cache.AotStep(
+                jax.jit(draft_prefill_fn, donate_argnums=donate),
+                label=f"serve/draft_prefill_L{bucket_len}",
+            )
+            self._draft_prefill_cells[bucket_len] = cell
+        return cell
 
     def _to_host(self, what: str, *arrays):
         """Materialize device results host-side.  On a sharded slice
@@ -1142,9 +1400,38 @@ class ServingEngine:
                     jobs.append((self._save_cell(bucket_len), (
                         pool_avals, cache_avals, scalar, ids_aval,
                     ), context))
-            jobs.append((self._chunk_step, (
-                params_avals, cache_avals, state_avals, rng_aval,
-            ), context))
+            if self._spec:
+                # Speculation replaces the decode chunk wholesale: warm
+                # the draft-prefill/draft/verify trio instead (the
+                # never-dispatched chunk program is skipped, like the
+                # insert programs under chunked prefill).
+                draft_params_avals = compile_cache.abstract_state(
+                    self._draft_params
+                )
+                draft_cache_avals = compile_cache.abstract_state(
+                    self._draft_cache
+                )
+                for bucket_len in cfg.prompt_buckets:
+                    tok_aval = jax.ShapeDtypeStruct(
+                        (1, bucket_len), np.int32
+                    )
+                    jobs.append((self._draft_prefill_cell(bucket_len), (
+                        draft_params_avals, draft_cache_avals, tok_aval,
+                        scalar, scalar,
+                    ), context))
+                jobs.append((self._draft_step, (
+                    draft_params_avals, draft_cache_avals, state_avals,
+                ), context))
+                window_aval = jax.ShapeDtypeStruct(
+                    (cfg.num_slots, cfg.draft.spec_k), np.int32
+                )
+                jobs.append((self._verify_step, (
+                    params_avals, cache_avals, state_avals, window_aval,
+                ), context))
+            else:
+                jobs.append((self._chunk_step, (
+                    params_avals, cache_avals, state_avals, rng_aval,
+                ), context))
             self._warmup_plan = compile_cache.start_compile_ahead(jobs)
             return
         jobs = []
@@ -1463,7 +1750,10 @@ class ServingEngine:
             if self._prefill_tasks:
                 self._advance_prefill()
             if self._active_slots:
-                self._dispatch_chunk()
+                if self._spec:
+                    self._dispatch_spec_chunk()
+                else:
+                    self._dispatch_chunk()
 
     def _pop_inserts_locked(self, inserts) -> None:
         """Claim one free slot per waiting request, oldest submit first
@@ -1688,6 +1978,11 @@ class ServingEngine:
             # Finished at insert (mirrors the program's active0 gate).
             self._retire_slot(slot)
         else:
+            if self._spec:
+                # The slot will decode: give the draft its prompt KV
+                # before the next proposal round (a retired-at-insert
+                # slot never needs one).
+                self._dispatch_draft_prefill(request, slot)
             self._active_slots.add(slot)
 
     def _insert_request(self, request: _Request, slot: int) -> None:
@@ -1760,16 +2055,125 @@ class ServingEngine:
             self._stats["chunks"] += 1
             self._stats["decode_slot_steps"] += num_slots * chunk
             self._stats["useful_decode_tokens"] += emitted
-        eos = cfg.sample.eos_id
+        self._commit_emissions(toks, valid, chunk)
+
+    def _commit_emissions(self, toks, valid, width: int) -> None:
+        """Mirror one dispatch's [slots, width] emissions into the host
+        slot table and retire what finished — shared verbatim by the
+        decode-chunk and verify paths (``valid`` is a per-row prefix in
+        both)."""
+        eos = self.serve_config.sample.eos_id
         for slot in sorted(self._active_slots):
             entry = self._slot_table[slot]
-            for i in range(chunk):
+            for i in range(width):
                 if not valid[slot, i]:
                     break
                 entry.tokens.append(int(toks[slot, i]))
             hit_eos = eos is not None and entry.tokens[-1] == eos
             if hit_eos or len(entry.tokens) >= entry.request.max_new_tokens:
                 self._retire_slot(slot)
+
+    def _dispatch_spec_chunk(self) -> None:
+        """One draft-and-verify round: the draft proposes a ``spec_k``
+        window per slot over its own cache (``serve/draft``), then the
+        target scores the whole window in ONE dispatch and commits the
+        accepted prefix (``serve/verify``).  Host-side emission
+        handling is byte-for-byte the chunk path's — only the token
+        source changed."""
+        cfg = self.serve_config
+        num_slots, k = cfg.num_slots, cfg.draft.spec_k
+        active_n = len(self._active_slots)
+
+        def draft_dispatch():
+            faults.fault_point("serve.draft")
+            return self._draft_step(
+                self._draft_params, self._draft_cache, self._slot_state
+            )
+
+        with tracing.span("serve/draft", slots=num_slots, spec_k=k,
+                          active=active_n):
+            self._draft_cache, window = self._supervised(
+                "serve/draft", draft_dispatch
+            )
+
+        def verify_dispatch():
+            faults.fault_point("serve.verify")
+            return self._verify_step(
+                self.params, self._grid_cache, self._slot_state, window
+            )
+
+        span_attrs = dict(slots=num_slots, spec_k=k, active=active_n)
+        if self._slice_chips > 1:
+            span_attrs["slice"] = (
+                f"{self._slice_shape[0]}x{self._slice_shape[1]}"
+            )
+            span_attrs["slice_chips"] = self._slice_chips
+        with tracing.span("serve/verify", **span_attrs) as verify_span:
+            self._grid_cache, self._slot_state, toks, valid = (
+                self._supervised("serve/verify", verify_dispatch)
+            )
+            toks, valid = self._to_host("verify_tokens", toks, valid)
+            emitted = int(valid.sum())
+            # Every active slot commits >= 1 token (the first-mismatch
+            # position's target token); the surplus is accepted drafts.
+            accepted = max(emitted - active_n, 0)
+            proposed = active_n * (k - 1)
+            occupancy = emitted / float(num_slots * k)
+            verify_span.set_attribute("tokens", emitted)
+            verify_span.set_attribute("accepted", accepted)
+            verify_span.set_attribute("proposed", proposed)
+            verify_span.set_attribute("occupancy", round(occupancy, 4))
+        metrics.counter_inc("serve/spec_chunks")
+        metrics.counter_inc("serve/spec_accepted_tokens", accepted)
+        metrics.gauge_set("serve/slot_occupancy", occupancy)
+        with self._stats_lock:
+            self._accept_window.append((accepted, proposed))
+            self._stats["spec_chunks"] += 1
+            self._stats["spec_emitted"] += emitted
+            self._stats["spec_accepted"] += accepted
+            self._stats["spec_proposed"] += proposed
+            self._stats["decode_slot_steps"] += num_slots * k
+            self._stats["useful_decode_tokens"] += emitted
+        metrics.gauge_set(
+            "serve/spec_accept_rate", self._rolling_acceptance()
+        )
+        self._commit_emissions(toks, valid, k)
+
+    def _rolling_acceptance(self) -> float:
+        """Acceptance over the last <=64 verify dispatches (health()'s
+        number; stats() carries the cumulative quotient).  Reads under
+        ``_stats_lock``: health() iterates from router threads while
+        the scheduler appends, and a deque raises on concurrent
+        mutation during iteration."""
+        with self._stats_lock:
+            accepted = sum(a for a, _ in self._accept_window)
+            proposed = sum(p for _, p in self._accept_window)
+        return accepted / proposed if proposed else 0.0
+
+    def _dispatch_draft_prefill(self, request: _Request, slot: int) -> None:
+        """Mirror a just-armed slot's prompt into the draft model's
+        cache row so the next proposal round attends over real context
+        (one-shot whatever the target side did — prefix hits and
+        chunked prefills stay target-only)."""
+        tokens = np.zeros((1, request.bucket_len), np.int32)
+        tokens[0, :request.prompt_len] = request.prompt
+        cell = self._draft_prefill_cell(request.bucket_len)
+
+        def dispatch():
+            faults.fault_point("serve.draft_prefill")
+            return cell(
+                self._draft_params, self._draft_cache, tokens,
+                np.int32(request.prompt_len), np.int32(slot),
+            )
+
+        with tracing.span("serve/draft_prefill",
+                          bucket=request.bucket_len, slot=slot):
+            self._draft_cache = self._supervised(
+                "serve/draft_prefill", dispatch
+            )
+        metrics.counter_inc("serve/draft_prefills")
+        with self._stats_lock:
+            self._stats["draft_prefills"] += 1
 
     def _retire_slot(self, slot: int, exc: Optional[BaseException] = None
                      ) -> None:
@@ -1992,6 +2396,15 @@ class ServingEngine:
             "last_dispatch_age_s": (
                 None if last is None else time.perf_counter() - last
             ),
+            # Speculative decoding (stable schema — zeros when off):
+            # the rolling acceptance over recent verify dispatches, and
+            # the armed window width.
+            "spec_acceptance_rate": (
+                self._rolling_acceptance() if self._spec else 0.0
+            ),
+            "spec_k": (
+                self.serve_config.draft.spec_k if self._spec else 0
+            ),
         }
         snap.update(self._prefix_snapshot())
         if self._continuous:
@@ -2036,6 +2449,12 @@ class ServingEngine:
         )
         snap["slice_shape"] = self._slice_shape
         snap["slice_chips"] = self._slice_chips
+        # Cumulative acceptance (health() carries the rolling one);
+        # 0.0 with draft=None — stable schema.
+        snap["spec_acceptance_rate"] = (
+            snap["spec_accepted"] / snap["spec_proposed"]
+            if snap["spec_proposed"] else 0.0
+        )
         snap.update(self._prefix_snapshot())
         return snap
 
@@ -2044,3 +2463,10 @@ class ServingEngine:
         """Python-trace count of the chunk program (continuous mode): 1
         after any amount of traffic == one compile served the run."""
         return self._chunk_traces if self._continuous else 0
+
+    @property
+    def verify_traces(self) -> int:
+        """Python-trace count of the speculative verify program: 1
+        after any amount of traffic == one compile served the run (0
+        with ``draft=None``)."""
+        return self._verify_traces if self._continuous else 0

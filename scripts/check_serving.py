@@ -32,7 +32,16 @@ SHARDED churn: the same staggered mixed-budget workload through a
 ``mesh_shape=(2, 1)`` engine on a 2-device CPU mesh — params and the
 slot KV cache sharded over the slice — with per-request parity against
 single-chip ``generate()``, the one-executable-per-bucket retrace guard
-despite the mesh, and the same zero-thread-leak contract.
+despite the mesh, and the same zero-thread-leak contract.  Phase 5 is
+the SPECULATIVE churn: draft-and-verify decoding under churn — a
+shared-weights draft (deterministic full-window acceptance, so the
+dispatch-count contract is provable: target verify dispatches strictly
+fewer than the tokens they emit) with an eos mid-window and a
+deadline-shed request landing while verifies are in flight, plus a
+genuinely smaller (1-layer, fresh-init) draft segment whose acceptance
+is whatever it is — parity vs per-request ``generate()`` either way,
+one draft/verify/draft-prefill executable each (retrace guard), and
+zero leaked threads.
 
 Prints one JSON line per phase plus a final summary::
 
@@ -93,7 +102,12 @@ def main(argv=None) -> int:
     import numpy as np
 
     from cloud_tpu.models import generation, transformer
-    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.serving import (
+        DeadlineExceededError,
+        DraftConfig,
+        ServeConfig,
+        ServingEngine,
+    )
 
     config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
     params = transformer.init(jax.random.PRNGKey(0), config)
@@ -440,15 +454,196 @@ def main(argv=None) -> int:
     }), flush=True)
     leaked_tp = _engine_threads()
 
+    # -- phase 5: speculative churn (draft-and-verify decoding) -----------
+    # Segment A: a SHARED-WEIGHTS draft (acceptance is deterministic —
+    # every window position matches) under churn with an eos mid-window
+    # and a deadline request shed while verifies are in flight.  The
+    # dispatch-count contract is the tentpole's win metric made a gate:
+    # the target's verify dispatches must be STRICTLY fewer than the
+    # tokens those dispatches emit.  Segment B: a genuinely smaller
+    # (1-layer, fresh-init) draft — acceptance is whatever two random
+    # tiny models give, parity must hold regardless.
+    spec_rng = np.random.default_rng(5)
+    spec_prompts = [
+        spec_rng.integers(1, 255, int(spec_rng.integers(2, 17))).astype(
+            np.int32
+        )
+        for _ in range(args.requests)
+    ]
+    spec_budgets = [
+        int(spec_rng.integers(1, MAX_NEW + 1)) for _ in spec_prompts
+    ]
+    spec_budgets[0] = MAX_NEW  # at least one full-budget row
+    # eos mid-window: make the first prompt's third greedy token the
+    # engine-wide eos, so its request finishes by eos inside a spec_k=3
+    # window rather than by budget.
+    probe_direct = generation.generate(
+        params, jnp.asarray(spec_prompts[0][None, :]),
+        jnp.asarray([len(spec_prompts[0])], np.int32), config,
+        max_new_tokens=MAX_NEW,
+        sample=generation.SampleConfig(temperature=0.0),
+    )
+    spec_eos = int(np.asarray(probe_direct["tokens"])[0][2])
+    spec_sample = generation.SampleConfig(
+        temperature=0.0, eos_id=spec_eos, pad_id=0
+    )
+    spec_serve = ServeConfig(
+        max_new_tokens=MAX_NEW,
+        prompt_buckets=(8, 16),
+        batch_buckets=(1, 2, 4),
+        sample=spec_sample,
+        draft=DraftConfig(config=config, params=params, spec_k=3),
+        warmup=True,
+    )
+    spec_futures = [None] * len(spec_prompts)
+    spec_engine = ServingEngine(params, config, spec_serve, mesh=None)
+    try:
+        spec_engine.wait_ready()
+
+        def spec_submitter(i):
+            time.sleep(float(i % 5) * 0.005)
+            spec_futures[i] = spec_engine.submit(
+                spec_prompts[i], max_new_tokens=spec_budgets[i]
+            )
+
+        spec_workers = [
+            threading.Thread(target=spec_submitter, args=(i,))
+            for i in range(len(spec_prompts))
+        ]
+        spec_start = time.perf_counter()
+        for w in spec_workers:
+            w.start()
+        for w in spec_workers:
+            w.join()
+        # Deadline expiry mid-verify: with the grid saturated and a deep
+        # queue, a 1 ms deadline passes while verify dispatches are in
+        # flight — the request must be shed with the typed error before
+        # ever claiming a slot.
+        doomed = spec_engine.submit(
+            spec_prompts[0], max_new_tokens=MAX_NEW, deadline_s=0.001
+        )
+        spec_results = [
+            f.result(timeout=args.timeout) for f in spec_futures
+        ]
+        spec_wall = time.perf_counter() - spec_start
+        try:
+            doomed.result(timeout=args.timeout)
+            spec_shed_ok = False
+        except DeadlineExceededError:
+            spec_shed_ok = True
+
+        spec_mismatches = 0
+        for prompt, budget, result in zip(spec_prompts, spec_budgets,
+                                          spec_results):
+            direct = generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([len(prompt)], np.int32), config,
+                max_new_tokens=budget, sample=spec_sample,
+            )
+            want = np.asarray(direct["tokens"])[0]
+            if not np.array_equal(result.tokens, want) or (
+                result.num_generated != int(direct["num_generated"][0])
+            ):
+                spec_mismatches += 1
+        spec_stats = spec_engine.stats()
+    finally:
+        spec_engine.close()
+    # Retrace guard: ONE draft, ONE verify, one draft-prefill per
+    # bucket — and the plain decode-chunk program NEVER dispatched.
+    spec_retrace_ok = (
+        spec_engine._draft_traces <= 1
+        and spec_engine.verify_traces <= 1
+        and spec_engine._draft_prefill_traces
+        <= len(spec_serve.prompt_buckets)
+        and spec_engine.chunk_traces == 0
+    )
+    spec_dispatch_ok = (
+        spec_stats["spec_chunks"] < spec_stats["spec_emitted"]
+    )
+    print(json.dumps({
+        "phase": "spec_churn",
+        "ok": spec_mismatches == 0,
+        "mismatches": spec_mismatches,
+        "spec_chunks": spec_stats["spec_chunks"],
+        "spec_emitted": spec_stats["spec_emitted"],
+        "acceptance_rate": round(spec_stats["spec_acceptance_rate"], 3),
+        "dispatches_lt_tokens": spec_dispatch_ok,
+        "shed_mid_verify": spec_shed_ok,
+        "tokens_per_sec": round(
+            sum(r.num_generated for r in spec_results) / spec_wall
+            if spec_wall else 0.0, 1
+        ),
+        "retrace_ok": spec_retrace_ok,
+    }), flush=True)
+
+    # Segment B: small real draft — different weights, parity anyway.
+    small_draft_cfg = config.scaled(num_layers=1)
+    small_draft_params = transformer.init(
+        jax.random.PRNGKey(9), small_draft_cfg
+    )
+    small_serve = ServeConfig(
+        max_new_tokens=MAX_NEW,
+        prompt_buckets=(8, 16),
+        batch_buckets=(1, 2, 4),
+        draft=DraftConfig(
+            config=small_draft_cfg, params=small_draft_params, spec_k=3
+        ),
+        warmup=True,
+    )
+    small_prompts = spec_prompts[:max(args.requests // 2, 2)]
+    small_budgets = spec_budgets[:len(small_prompts)]
+    small_engine = ServingEngine(params, config, small_serve, mesh=None)
+    try:
+        small_engine.wait_ready()
+        small_futures = [
+            small_engine.submit(p, max_new_tokens=b)
+            for p, b in zip(small_prompts, small_budgets)
+        ]
+        small_results = [
+            f.result(timeout=args.timeout) for f in small_futures
+        ]
+        small_mismatches = 0
+        for prompt, budget, result in zip(small_prompts, small_budgets,
+                                          small_results):
+            direct = generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([len(prompt)], np.int32), config,
+                max_new_tokens=budget,
+                sample=generation.SampleConfig(temperature=0.0),
+            )
+            if not np.array_equal(
+                result.tokens, np.asarray(direct["tokens"])[0]
+            ):
+                small_mismatches += 1
+        small_stats = small_engine.stats()
+    finally:
+        small_engine.close()
+    # >= 1 committed token per active slot per dispatch, whatever the
+    # draft proposes: an all-rejected window is just a slow step.
+    small_floor_ok = (
+        small_stats["spec_emitted"] >= small_stats["spec_chunks"]
+    )
+    print(json.dumps({
+        "phase": "spec_small_draft",
+        "ok": small_mismatches == 0,
+        "mismatches": small_mismatches,
+        "acceptance_rate": round(small_stats["spec_acceptance_rate"], 3),
+        "emissions_floor_ok": small_floor_ok,
+    }), flush=True)
+    leaked_spec = _engine_threads()
+
     ok = (
         mismatches == 0 and churn_mismatches == 0
         and prefix_mismatches == 0 and tp_mismatches == 0
+        and spec_mismatches == 0 and small_mismatches == 0
         and not leaked and not leaked_churn and not leaked_prefix
-        and not leaked_tp
+        and not leaked_tp and not leaked_spec
         and stats["completed"] == len(prompts)
         and churn_stats["completed"] == len(churn_prompts)
         and prefix_stats["completed"] == len(prefix_prompts)
         and tp_stats["completed"] == len(tp_prompts)
+        and spec_stats["completed"] == len(spec_prompts)
+        and small_stats["completed"] == len(small_prompts)
         # The whole churn run — reuse, expiry, staggered inserts — must
         # have retraced the chunk program exactly once.
         and churn_engine.chunk_traces == 1
@@ -460,15 +655,30 @@ def main(argv=None) -> int:
         # Sharded phase: a real 2-chip slice, compile-once programs.
         and tp_health["slice_chips"] == 2
         and tp_retrace_ok
+        # Speculative phase: strictly fewer target dispatches than
+        # tokens emitted (the tentpole's win metric), acceptance > 0,
+        # the mid-verify deadline shed landed typed, one executable per
+        # spec program, and the small-draft emissions floor held.
+        and spec_dispatch_ok
+        and spec_stats["spec_acceptance_rate"] > 0
+        and spec_shed_ok
+        and spec_retrace_ok
+        and small_floor_ok
     )
     print(json.dumps({
         "phase": "summary",
         "ok": ok,
+        # The spec phase's deadline request is shed BY DESIGN: count
+        # servable requests so requests == completed stays the summary
+        # invariant (the shed itself is gated via spec_shed_ok).
         "requests": (stats["requests"] + churn_stats["requests"]
-                     + prefix_stats["requests"] + tp_stats["requests"]),
+                     + prefix_stats["requests"] + tp_stats["requests"]
+                     + spec_stats["requests"] - spec_stats["shed"]
+                     + small_stats["requests"]),
         "completed": (stats["completed"] + churn_stats["completed"]
                       + prefix_stats["completed"]
-                      + tp_stats["completed"]),
+                      + tp_stats["completed"] + spec_stats["completed"]
+                      + small_stats["completed"]),
         "batches": stats["batches"],
         "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 3),
         "continuous_occupancy": round(
@@ -476,8 +686,12 @@ def main(argv=None) -> int:
         ),
         "prefix_hit_tokens_per_sec": round(hit_tokens_per_sec, 1),
         "sharded_slice_chips": tp_health["slice_chips"],
+        "spec_acceptance_rate": round(
+            spec_stats["spec_acceptance_rate"], 3
+        ),
+        "spec_dispatches_lt_tokens": spec_dispatch_ok,
         "leaked_threads": (leaked + leaked_churn + leaked_prefix
-                           + leaked_tp),
+                           + leaked_tp + leaked_spec),
         "wall_seconds": round(time.perf_counter() - start, 3),
     }), flush=True)
     return 0 if ok else 1

@@ -843,3 +843,240 @@ class TestQuantizedKvCache:
         quant = _init_cache(cfg, 2, 64, DEFAULT_RULES, None, kv_quant=True)
         # int8 + f32/hd scales vs the config dtype cache.
         assert param_bytes(quant) < 0.7 * param_bytes(full)
+
+
+class TestSpeculativePrograms:
+    """Draft-and-verify on the slot grid (ISSUE 12), engine-free: the
+    verify program's committed emissions must be token-identical to the
+    sequential decode path, whatever the draft proposes — proposals
+    steer acceptance (how many tokens one target dispatch commits),
+    never content.  The degenerate cases are pinned at this level
+    because they are deterministic here: a crafted all-rejected window
+    still commits exactly one token per active slot, and a
+    shared-weights draft accepts full windows so the dispatch count is
+    provably sub-one-per-token."""
+
+    def _model(self):
+        config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=1)
+        params = transformer.init(jax.random.PRNGKey(0), config)
+        return config, params
+
+    def _insert_fns(self, config, sample):
+        """Jitted insert + draft-prefill pair (prompt_len/slot/budget
+        traced, so one compile each serves every slot and both grid
+        builds of a test)."""
+        insert_fn = jax.jit(
+            lambda p, c, st, tok, ln, slot, m:
+            generation.insert_slot_program(
+                p, c, st, tok, ln, slot, m, config, sample=sample,
+            )
+        )
+        dprefill_fn = jax.jit(
+            lambda p, c, tok, ln, slot:
+            generation.draft_prefill_slot_program(
+                p, c, tok, ln, slot, config,
+            )
+        )
+        return insert_fn, dprefill_fn
+
+    def _armed_grid(self, config, params, sample, prompts, budgets,
+                    draft_params, insert_fns=None, bucket=8, max_len=16):
+        """Insert each prompt into its slot (target) and prefill the
+        draft cache rows; returns (cache, draft_cache, state, live)."""
+        if insert_fns is None:
+            insert_fns = self._insert_fns(config, sample)
+        insert_fn, dprefill_fn = insert_fns
+        n = len(prompts)
+        cache = generation.init_slot_cache(config, n, max_len)
+        dcache = generation.init_slot_cache(config, n, max_len)
+        state = generation.init_slot_state(config, n, sample=sample)
+        live = {}
+        for slot, (prompt, budget) in enumerate(zip(prompts, budgets)):
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(prompt)] = prompt
+            cache, state, tok0 = insert_fn(
+                params, cache, state, jnp.asarray(padded),
+                np.int32(len(prompt)), np.int32(slot), np.int32(budget),
+            )
+            dcache = dprefill_fn(
+                draft_params, dcache, jnp.asarray(padded),
+                np.int32(len(prompt)), np.int32(slot),
+            )
+            live[slot] = [int(tok0)]
+        return cache, dcache, state, live
+
+    def _spec_round(self, config, sample, spec_k):
+        """Jitted draft+verify pair — ONE compile each serves every
+        drive-loop iteration and every draft-params variant (params are
+        traced arguments), exactly the engine's compile economy."""
+        draft_fn = jax.jit(
+            lambda dp, dc, st: generation.draft_chunk_program(
+                dp, dc, st, config, spec_k=spec_k,
+            )
+        )
+        verify_fn = jax.jit(
+            lambda p, c, st, w: generation.verify_chunk_program(
+                p, c, st, w, config, sample=sample,
+            )
+        )
+        return draft_fn, verify_fn
+
+    def _drive_spec(self, params, draft_params, cache, dcache, state,
+                    live, spec_k, round_fns):
+        """Draft-and-verify rounds until every slot retires; returns
+        the per-dispatch (active, emitted) trail."""
+        draft_fn, verify_fn = round_fns
+        trail = []
+        while bool(np.asarray(state["active"]).any()):
+            active_n = int(np.asarray(state["active"]).sum())
+            dcache, window = draft_fn(draft_params, dcache, state)
+            cache, state, toks, valid = verify_fn(
+                params, cache, state, window
+            )
+            toks, valid = np.asarray(toks), np.asarray(valid)
+            trail.append((active_n, int(valid.sum())))
+            for slot, tokens in live.items():
+                for i in range(spec_k):
+                    if valid[slot, i]:
+                        tokens.append(int(toks[slot, i]))
+            assert len(trail) < 40, "speculative loop failed to converge"
+        return trail
+
+    @pytest.mark.slow
+    def test_shared_and_mismatching_drafts_match_generate(self):
+        """The two acceptance extremes through ONE compiled round pair.
+        draft == target: every proposal matches, each dispatch commits
+        a full window (modulo budget) — strictly fewer verify dispatches
+        than tokens emitted.  A fresh-init draft: acceptance collapses,
+        but every committed token is still the target's own greedy
+        choice — parity is unconditional, with >= 1 emission per active
+        slot per dispatch.
+
+        Slow tier (tier-1 wall-clock sits against its 870s budget, the
+        PR 8/10 precedent): both extremes stay pinned FAST at engine
+        level — test_serving.py TestSpeculative's shared-draft test
+        asserts full-window acceptance + dispatches < tokens, its
+        mismatching-draft test the parity/floor — and e2e under churn
+        by scripts/check_serving.py phase 5 every CI run; the program-
+        level degenerate cases below (all-rejected window, budget/eos
+        truncation) remain fast."""
+        config, params = self._model()
+        draft_params = transformer.init(jax.random.PRNGKey(7), config)
+        sample = generation.SampleConfig(temperature=0.0)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, 255, n).astype(np.int32)
+                   for n in (5, 3)]
+        budgets = (7, 4)
+        round_fns = self._spec_round(config, sample, spec_k=3)
+        oracles = [
+            list(np.asarray(generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([len(prompt)], np.int32), config,
+                max_new_tokens=budget, sample=sample,
+            )["tokens"])[0])
+            for prompt, budget in zip(prompts, budgets)
+        ]
+
+        insert_fns = self._insert_fns(config, sample)
+        cache, dcache, state, live = self._armed_grid(
+            config, params, sample, prompts, budgets, params,
+            insert_fns=insert_fns)
+        trail = self._drive_spec(
+            params, params, cache, dcache, state, live, 3, round_fns)
+        for slot in range(len(prompts)):
+            assert live[slot] == oracles[slot]
+        decode_emissions = sum(e for _, e in trail)
+        assert len(trail) < decode_emissions
+        # Full first window: both slots had >= spec_k budget left, so
+        # the shared-weights draft commits 3 tokens per slot at once.
+        assert trail[0] == (2, 6)
+
+        cache, dcache, state, live = self._armed_grid(
+            config, params, sample, prompts, budgets, draft_params,
+            insert_fns=insert_fns)
+        trail = self._drive_spec(
+            params, draft_params, cache, dcache, state, live, 3,
+            round_fns)
+        for slot in range(len(prompts)):
+            assert live[slot] == oracles[slot]
+        for active_n, emitted in trail:
+            assert emitted >= active_n
+
+    def test_all_rejected_window_commits_exactly_one_token(self):
+        """A window whose every proposal is crafted to mismatch the
+        target's greedy choice degenerates to the non-speculative step:
+        exactly one committed token per active slot, pos advanced by
+        one."""
+        config, params = self._model()
+        sample = generation.SampleConfig(temperature=0.0)
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(1, 255, n).astype(np.int32)
+                   for n in (5, 3)]
+        cache, dcache, state, live = self._armed_grid(
+            config, params, sample, prompts, (4, 4), params)
+        spec_k = 3
+        _, verify_fn = self._spec_round(config, sample, spec_k)
+        # Learn the greedy next tokens from a throwaway verify, then
+        # craft proposals one off from each — guaranteed mismatches
+        # (same jitted program both times: one compile).
+        probe_cache = jax.tree_util.tree_map(jnp.copy, cache)
+        _, _, probe_toks, _ = verify_fn(
+            params, probe_cache, dict(state),
+            jnp.stack([state["tok"]] * spec_k, axis=1),
+        )
+        g0 = np.asarray(probe_toks)[:, 0]
+        wrong = (g0 + 1) % config.vocab_size
+        window = np.stack(
+            [np.asarray(state["tok"])] + [wrong] * (spec_k - 1), axis=1
+        )
+        pos_before = np.asarray(state["pos"]).copy()
+        cache, state, toks, valid = verify_fn(
+            params, cache, state, jnp.asarray(window).astype(jnp.int32),
+        )
+        valid = np.asarray(valid)
+        assert valid[:, 0].all() and not valid[:, 1:].any()
+        np.testing.assert_array_equal(
+            np.asarray(state["pos"]), pos_before + 1
+        )
+        np.testing.assert_array_equal(np.asarray(toks)[:, 0], g0)
+
+    def test_verify_truncates_at_budget_and_eos(self):
+        """The window may offer spec_k tokens; ``remaining`` and eos cap
+        the commit exactly as the sequential path would."""
+        config, params = self._model()
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(1, 255, 5).astype(np.int32)
+        plain = generation.generate(
+            params, jnp.asarray(prompt[None, :]),
+            jnp.asarray([len(prompt)], np.int32), config,
+            max_new_tokens=6,
+            sample=generation.SampleConfig(temperature=0.0),
+        )
+        eos = int(np.asarray(plain["tokens"])[0][2])
+        sample = generation.SampleConfig(temperature=0.0, eos_id=eos,
+                                         pad_id=0)
+        # Slot 0: eos arrives at emission index 2, inside the first
+        # spec_k=4 window.  Slot 1: budget 2 truncates the same window.
+        cache, dcache, state, live = self._armed_grid(
+            config, params, sample, [prompt, prompt], (6, 2), params)
+        self._drive_spec(
+            params, params, cache, dcache, state, live, 4,
+            self._spec_round(config, sample, spec_k=4),
+        )
+        # Oracles derive from the one plain run: greedy-with-eos is the
+        # plain stream cut after the first eos (emitted inclusive), and
+        # a budget is a prefix — no further generate() compiles needed.
+        plain_toks = list(np.asarray(plain["tokens"])[0])
+        assert live[0] == plain_toks[:3]  # t0, t1, eos
+        assert live[1] == plain_toks[:2]  # budget 2
+
+    def test_verify_rejects_non_greedy(self):
+        config, params = self._model()
+        state = generation.init_slot_state(config, 1)
+        cache = generation.init_slot_cache(config, 1, 8)
+        with pytest.raises(ValueError, match="greedy"):
+            generation.verify_chunk_program(
+                params, cache, state, jnp.zeros((1, 2), jnp.int32),
+                config,
+                sample=generation.SampleConfig(temperature=0.7),
+            )

@@ -175,6 +175,26 @@ class TestServeLayoutPlanner:
         with pytest.raises(ValueError, match="sp"):
             planner.plan_serve_layout(num_heads=2, num_devices=2, sp=4)
 
+    def test_draft_bytes_budget_replicated_per_chip(self):
+        """ISSUE 12: a speculative draft rides every chip undivided
+        (replicated bound), so the budget search must widen tp until
+        params/tp + kv/tp + draft fits — and an unfittable draft raises
+        naming the draft term."""
+        # Without the draft, tp=4 fits 30 bytes/chip (the baseline
+        # budget test above); a 6-byte replicated draft pushes tp=4 to
+        # 31 > 30, so the planner must widen to tp=8 (8 + 5 + 6 = 19).
+        layout = planner.plan_serve_layout(
+            num_heads=8, num_devices=8, param_bytes=60, kv_bytes=40,
+            draft_bytes=6, hbm_bytes_per_chip=30,
+        )
+        assert layout.tp == 8
+        assert layout.draft_bytes_per_chip == 6
+        with pytest.raises(ValueError, match="draft 30"):
+            planner.plan_serve_layout(
+                num_heads=8, num_devices=8, param_bytes=60, kv_bytes=40,
+                draft_bytes=30, hbm_bytes_per_chip=30,
+            )
+
 
 class TestShardingRules:
     def test_default_rules_specs(self):
