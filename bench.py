@@ -147,6 +147,25 @@ SERVE_SPEC_DRAFT_LAYERS = 3
 #: replicas share the chip (the router still spreads queueing).
 FLEET_REPLICAS = 2
 
+#: Open-loop arrival sweep at the fleet surface (ROADMAP item 5's
+#: latency-under-load curves): requests arrive on a fixed wall-clock
+#: schedule at each offered QPS — open loop, so queueing delay shows up
+#: in TTFT instead of throttling the arrival rate (closed-loop probes
+#: can't see saturation).  Each point emits tokens/sec plus TTFT and
+#: TPOT p50/p99; the mixed-class run (QoS armed, alternating
+#: interactive/batch arrivals) additionally emits per-class TTFT p99 —
+#: the curve pair the priority scheduler's whole existence is judged
+#: by.  The low point should ride under capacity, the high point past
+#: it, so the pair brackets the knee.
+FLEET_SWEEP_QPS = (4, 16)
+FLEET_SWEEP_REQUESTS = 12
+FLEET_SWEEP_PROMPT_LEN = 32
+FLEET_SWEEP_NEW_TOKENS = 16
+#: Few slots per replica ON PURPOSE: the sweep's job is the queueing
+#: regime (slot admission order is where QoS lives); a grid wide enough
+#: to hold every arrival in flight would measure nothing but decode.
+FLEET_SWEEP_SLOTS = 2
+
 METRIC = f"resnet50_cifar10_b{BATCH_SIZE}_train_steps_per_sec_per_chip"
 
 #: The last DRIVER-VERIFIED number (BENCH_r02.json, 2026-07-29, TPU v5e-1,
@@ -1170,6 +1189,117 @@ def _measure_fleet(extras):
     )
 
 
+def _measure_fleet_qps_sweep(extras):
+    """Open-loop arrival sweep at the fleet surface: tokens/sec and
+    TTFT/TPOT percentiles vs OFFERED load (constants block above).
+
+    Two passes per offered-QPS point over one 2-replica QoS fleet:
+    requests alternate interactive/batch classes, arrivals follow the
+    wall clock (a late submission does not push later ones — open
+    loop), and every request's TTFT is the fleet-surface number (fleet
+    queueing + routing + engine queue + prefill).  Emits per-point
+    aggregates plus per-class TTFT p99, so a round artifact carries a
+    small latency-under-load curve instead of one point.
+    """
+    from cloud_tpu.fleet import Fleet, FleetConfig
+    from cloud_tpu.serving import QosConfig, ServeConfig, ServingEngine
+    from cloud_tpu.utils.benchmarking import decode_setup
+
+    import numpy as np
+
+    cfg, params, _, _ = decode_setup(
+        batch_size=FLEET_SWEEP_SLOTS, prompt_len=FLEET_SWEEP_PROMPT_LEN
+    )
+    serve = ServeConfig(
+        max_new_tokens=FLEET_SWEEP_NEW_TOKENS,
+        prompt_buckets=(FLEET_SWEEP_PROMPT_LEN,),
+        batch_buckets=(1, FLEET_SWEEP_SLOTS),
+        num_slots=FLEET_SWEEP_SLOTS,
+        chunk_tokens=SERVE_CHURN_CHUNK,
+        warmup=True,
+        qos=QosConfig(),
+    )
+
+    def factory():
+        return ServingEngine(params, cfg, serve, mesh=None)
+
+    rng = np.random.default_rng(3)
+    with Fleet(factory, FleetConfig(
+        min_replicas=FLEET_REPLICAS, max_replicas=FLEET_REPLICAS,
+        poll_interval_s=0.1, qos=QosConfig(),
+    )) as fleet:
+        fleet.wait_ready()
+        fleet.submit(
+            rng.integers(1, cfg.vocab_size, 4).astype(np.int32),
+            max_new_tokens=2,
+        ).result()  # absorb residual first-dispatch latency
+        for qps in FLEET_SWEEP_QPS:
+            prompts = [
+                rng.integers(
+                    1, cfg.vocab_size, FLEET_SWEEP_PROMPT_LEN
+                ).astype(np.int32)
+                for _ in range(FLEET_SWEEP_REQUESTS)
+            ]
+            classes = [
+                "interactive" if i % 2 == 0 else "batch"
+                for i in range(FLEET_SWEEP_REQUESTS)
+            ]
+            interval = 1.0 / qps
+            start = time.perf_counter()
+            futures = []
+            for i, prompt in enumerate(prompts):
+                # Open loop: arrivals track the wall clock, not the
+                # fleet's progress.
+                target = start + i * interval
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                futures.append(fleet.submit(
+                    prompt, max_new_tokens=FLEET_SWEEP_NEW_TOKENS,
+                    priority=classes[i],
+                ))
+            results = [f.result() for f in futures]
+            wall = time.perf_counter() - start
+
+            ttfts = sorted(r.ttft_seconds for r in results)
+            tpots = sorted(
+                (r.latency_seconds - r.ttft_seconds)
+                / max(r.num_generated - 1, 1)
+                for r in results
+            )
+            total_tokens = sum(r.num_generated for r in results)
+            key = f"fleet_sweep_q{qps}"
+            extras[f"{key}_tokens_per_sec"] = round(
+                total_tokens / wall, 1
+            )
+            extras[f"{key}_ttft_p50_seconds"] = round(
+                _latency_pct(ttfts, 0.5), 4
+            )
+            extras[f"{key}_ttft_p99_seconds"] = round(
+                _latency_pct(ttfts, 0.99), 4
+            )
+            extras[f"{key}_tpot_p50_seconds"] = round(
+                _latency_pct(tpots, 0.5), 5
+            )
+            extras[f"{key}_tpot_p99_seconds"] = round(
+                _latency_pct(tpots, 0.99), 5
+            )
+            for name in ("interactive", "batch"):
+                class_ttfts = sorted(
+                    r.ttft_seconds
+                    for r, c in zip(results, classes) if c == name
+                )
+                extras[f"{key}_{name}_ttft_p99_seconds"] = round(
+                    _latency_pct(class_ttfts, 0.99), 4
+                )
+    extras["fleet_sweep_config"] = (
+        f"SMALL replicas{FLEET_REPLICAS} open-loop "
+        f"qps{list(FLEET_SWEEP_QPS)} n{FLEET_SWEEP_REQUESTS}/point "
+        f"prompt{FLEET_SWEEP_PROMPT_LEN} new{FLEET_SWEEP_NEW_TOKENS} "
+        "classes interactive/batch alternating, QoS armed"
+    )
+
+
 def _measure_durability(extras):
     """Durability probe on the CIFAR workload (the headline's state):
 
@@ -1296,6 +1426,7 @@ def _child_main() -> int:
         (_measure_serving_spec, "serving_spec"),
         (_measure_serving_tp, "serving_tp"),
         (_measure_fleet, "fleet"),
+        (_measure_fleet_qps_sweep, "fleet_qps_sweep"),
         (_measure_durability, "durability"),
     ):
         phase_extras = {"peak_bf16_tflops": extras.get("peak_bf16_tflops")}

@@ -26,8 +26,25 @@ from cloud_tpu.fleet.fleet import (
 )
 from cloud_tpu.fleet.replica import Replica
 from cloud_tpu.fleet.router import LeastLoadedRouter
+# QoS policy types live in cloud_tpu.serving.qos (one canonical home);
+# re-exported here because FleetConfig.qos and the quota/shed errors
+# are part of the fleet's submit surface.
+from cloud_tpu.serving.qos import (
+    BrownoutShedError,
+    PriorityClass,
+    QosConfig,
+    QuotaExceededError,
+    TenantQuota,
+    TokenStream,
+)
 
 __all__ = [
+    "BrownoutShedError",
+    "PriorityClass",
+    "QosConfig",
+    "QuotaExceededError",
+    "TenantQuota",
+    "TokenStream",
     "AutoscaleConfig",
     "Fleet",
     "FleetClosedError",

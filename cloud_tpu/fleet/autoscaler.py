@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+from typing import Dict, Mapping, Optional
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,6 +53,13 @@ class AutoscaleConfig:
     scale_down_occupancy: float = 0.0
     #: Observations after any scale event before the next may fire.
     cooldown: int = 3
+    #: Per-CLASS scale-up thresholds (QoS fleets): class name ->
+    #: queued-requests-per-ready-replica that every observation in the
+    #: window must reach.  Lets an interactive backlog trigger capacity
+    #: at a depth the total-queue threshold would shrug off (a small
+    #: interactive pile-up hurts more than a big batch one).  ``None``
+    #: (default): the total-depth signal alone decides.
+    class_scale_up_depth: Optional[Mapping[str, float]] = None
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -67,6 +75,15 @@ class AutoscaleConfig:
             raise ValueError("window and idle_window must be >= 1")
         if self.cooldown < 0:
             raise ValueError(f"cooldown must be >= 0, got {self.cooldown}")
+        if self.class_scale_up_depth is not None:
+            depths = dict(self.class_scale_up_depth)
+            object.__setattr__(self, "class_scale_up_depth", depths)
+            for name, depth in depths.items():
+                if depth <= 0:
+                    raise ValueError(
+                        f"class_scale_up_depth[{name!r}] must be > 0, "
+                        f"got {depth}"
+                    )
 
 
 class QueueDepthAutoscaler:
@@ -75,19 +92,35 @@ class QueueDepthAutoscaler:
     def __init__(self, config: AutoscaleConfig):
         self.config = config
         self._depths = collections.deque(maxlen=config.window)
+        #: Per-class windowed depths (QoS fleets feed class_backlog).
+        self._class_depths: Dict[str, collections.deque] = {}
         self._idle_streak = 0
         self._cooldown_left = 0
 
     def observe(self, *, queue_depth: int, ready_replicas: int,
-                occupancy: float = 0.0) -> str:
+                occupancy: float = 0.0,
+                class_backlog: Optional[Mapping[str, int]] = None) -> str:
         """One windowed observation -> ``"up" | "down" | "hold"``.
 
         ``queue_depth`` is the fleet-level waiting count, ``occupancy``
-        the mean fraction of decode slots in use across ready replicas.
-        A fired decision resets both windows and starts the cooldown.
+        the mean fraction of decode slots in use across ready replicas,
+        ``class_backlog`` the per-class waiting counts (QoS fleets; the
+        per-class thresholds only see classes it names).  A fired
+        decision resets every window and starts the cooldown.
         """
         cfg = self.config
         self._depths.append(queue_depth / max(ready_replicas, 1))
+        if class_backlog is not None and cfg.class_scale_up_depth:
+            for name in cfg.class_scale_up_depth:
+                window = self._class_depths.get(name)
+                if window is None:
+                    window = self._class_depths[name] = collections.deque(
+                        maxlen=cfg.window
+                    )
+                window.append(
+                    int(class_backlog.get(name, 0)) /
+                    max(ready_replicas, 1)
+                )
         if queue_depth == 0 and occupancy <= cfg.scale_down_occupancy:
             self._idle_streak += 1
         else:
@@ -102,6 +135,15 @@ class QueueDepthAutoscaler:
         ):
             self._fired()
             return "up"
+        # Per-class trigger: a sustained backlog in any thresholded
+        # class scales up even when the total depth looks tolerable.
+        if cfg.class_scale_up_depth and ready_replicas < cfg.max_replicas:
+            for name, threshold in cfg.class_scale_up_depth.items():
+                window = self._class_depths.get(name)
+                if (window is not None and len(window) == cfg.window
+                        and min(window) >= threshold):
+                    self._fired()
+                    return "up"
         if (
             self._idle_streak >= cfg.idle_window
             and ready_replicas > cfg.min_replicas
@@ -112,5 +154,7 @@ class QueueDepthAutoscaler:
 
     def _fired(self) -> None:
         self._depths.clear()
+        for window in self._class_depths.values():
+            window.clear()
         self._idle_streak = 0
         self._cooldown_left = self.config.cooldown

@@ -68,6 +68,14 @@ from cloud_tpu.serving.engine import (
     QueueFullError,
     ServeResult,
 )
+from cloud_tpu.serving import qos as qos_lib
+from cloud_tpu.serving.qos import (
+    BrownoutShedError,
+    QosConfig,
+    QuotaExceededError,
+    TokenBucket,
+    TokenStream,
+)
 from cloud_tpu.utils import faults, retries
 
 logger = logging.getLogger(__name__)
@@ -111,15 +119,17 @@ def route_transient(exc: BaseException) -> bool:
     """Failover classification for routing and completion failures.
 
     Permanent: an expired deadline (shed, never re-submitted), a closed
-    fleet, and caller errors (bad prompt shape / budget — a retry would
-    fail identically).  Everything else — queue-full, a replica that
-    closed or crashed mid-request, a watchdogged dispatch, an injected
-    chaos fault — is the replica's failure, not the request's, and the
-    request deserves another candidate.
+    fleet, caller errors (bad prompt shape / budget — a retry would
+    fail identically), and the QoS verdicts — an exceeded quota or a
+    brownout shed (re-submitting into the same overload amplifies it).
+    Everything else — queue-full, a replica that closed or crashed
+    mid-request, a watchdogged dispatch, an injected chaos fault — is
+    the replica's failure, not the request's, and the request deserves
+    another candidate.
     """
     return not isinstance(
         exc, (DeadlineExceededError, FleetClosedError, ValueError,
-              TypeError),
+              TypeError, QuotaExceededError, BrownoutShedError),
     )
 
 
@@ -148,6 +158,15 @@ class FleetConfig:
     #: Autoscaler thresholds; ``min/max_replicas`` above are authoritative
     #: (they overwrite the ones in a user-supplied AutoscaleConfig).
     autoscale: Optional[AutoscaleConfig] = None
+    #: Multi-tenant QoS at the fleet surface: per-tenant token-bucket
+    #: quotas enforced at ``submit()`` (typed ``QuotaExceededError``),
+    #: fleet-queue ordering by (SLO slack, weighted fairness debt)
+    #: instead of arrival order, class-aware brownout shedding, and the
+    #: per-class backlog signal for the router/autoscaler.  ``None``
+    #: (default) keeps the FIFO fleet byte-identical (per-class keys
+    #: read zero).  Independent of the engines' own ``ServeConfig.qos``
+    #: — arm both for end-to-end class ordering.
+    qos: Optional[QosConfig] = None
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -170,6 +189,11 @@ class FleetConfig:
             )
         if self.poll_interval_s <= 0:
             raise ValueError("poll_interval_s must be > 0")
+        if self.qos is not None and not isinstance(self.qos, QosConfig):
+            raise ValueError(
+                f"qos must be a serving.qos.QosConfig, got "
+                f"{type(self.qos).__name__}"
+            )
         base = self.autoscale or AutoscaleConfig()
         object.__setattr__(self, "autoscale", dataclasses.replace(
             base, min_replicas=self.min_replicas,
@@ -177,7 +201,10 @@ class FleetConfig:
         ))
 
 
-@dataclasses.dataclass
+#: eq=False: requests are removed from mid-queue by IDENTITY (QoS
+#: admission, brownout shed) — a generated __eq__ would compare numpy
+#: prompt arrays element-wise and raise on the first non-match.
+@dataclasses.dataclass(eq=False)
 class _FleetRequest:
     prompt: np.ndarray
     max_new_tokens: Optional[int]
@@ -189,6 +216,19 @@ class _FleetRequest:
     #: Hash of the prompt's leading tokens — the router's
     #: prefix-affinity tie-break key (ignored by routers without one).
     affinity_key: Optional[int] = None
+    #: QoS class (resolved at submit when FleetConfig.qos is armed;
+    #: carried-but-inert otherwise) and the submitting tenant.
+    priority: Optional[str] = None
+    tenant: Optional[str] = None
+    #: Per-token stream (``submit(stream=True)``): fed by the serving
+    #: replica through an ``on_token`` forward (idempotent by index, so
+    #: a failover's deterministic re-run resumes it), closed by the
+    #: fleet future's done-callback.
+    stream: Optional[TokenStream] = None
+    #: Fairness debt charged (at the first pop): a failover re-entry is
+    #: popped again but must not charge its class a second time for
+    #: service it never received.
+    charged: bool = False
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -221,20 +261,40 @@ class Fleet:
         self.config = config or FleetConfig()
         self._factory = engine_factory
         self._router = router or LeastLoadedRouter()
-        # Custom routers predating the prefix-affinity tie-break keep
-        # their two-argument pick(); probe the signature once.
+        # Custom routers predating the prefix-affinity tie-break (or the
+        # QoS-aware priority hint) keep their two-argument pick();
+        # probe the signature once.
         try:
-            self._pick_takes_affinity = "affinity_key" in (
-                inspect.signature(self._router.pick).parameters
-            )
+            pick_params = inspect.signature(
+                self._router.pick
+            ).parameters
         except (TypeError, ValueError):  # pragma: no cover - exotic pick
-            self._pick_takes_affinity = False
+            pick_params = {}
+        self._pick_takes_affinity = "affinity_key" in pick_params
+        self._pick_takes_priority = "priority" in pick_params
         self._route_policy = (
             self.config.route_policy
             if self.config.route_policy is not None
             else default_route_policy()
         )
         self._autoscaler = QueueDepthAutoscaler(self.config.autoscale)
+        #: QoS state (None keeps the FIFO fleet byte-identical): the
+        #: admission-order policy, the per-tenant token buckets (built
+        #: lazily so unlisted tenants under a default_quota get one on
+        #: first submit), and per-class counters for health()/stats().
+        self._qos = self.config.qos
+        self._qos_sched = (
+            qos_lib.QosScheduler(self._qos) if self._qos else None
+        )
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._buckets_lock = threading.Lock()
+        classes = (
+            tuple(self._qos.classes) if self._qos
+            else qos_lib.DEFAULT_PRIORITIES
+        )
+        self._class_names = classes
+        self._class_completed = {c: 0 for c in classes}
+        self._class_shed = {c: 0 for c in classes}
 
         self._cond = threading.Condition()
         self._queue: collections.deque = collections.deque()
@@ -255,6 +315,8 @@ class Fleet:
             "submitted": 0, "completed": 0, "failed": 0, "rejected": 0,
             "shed": 0, "failovers": 0, "restarts": 0,
             "scale_ups": 0, "scale_downs": 0,
+            # QoS counters (0 unless FleetConfig.qos arms them).
+            "quota_rejected": 0, "brownout_shed": 0,
         }
         self._routed: Dict[int, int] = {}
 
@@ -397,10 +459,18 @@ class Fleet:
     # -- submission --------------------------------------------------------
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               priority: Optional[str] = None,
+               tenant: Optional[str] = None,
+               stream: bool = False) -> Future:
         """Enqueue one prompt; returns a Future of the replica's result
         (a :class:`~cloud_tpu.serving.ServeResult` for real engines,
-        with ``latency_seconds`` re-based to the *fleet* submit time).
+        with ``latency_seconds`` AND ``ttft_seconds`` re-based to the
+        *fleet* submit time), or a
+        :class:`~cloud_tpu.serving.qos.TokenStream` with ``stream=True``
+        — fed per token by the serving replica, failover-transparent
+        (a re-run's deterministic greedy tokens resume the stream
+        without duplicates).
 
         Same surface as ``ServingEngine.submit``: ``deadline_s`` bounds
         the total queue wait — fleet queue plus replica queue; the
@@ -408,15 +478,47 @@ class Fleet:
         and an expired request is shed typed, never served late.
         Thread-safe; blocks or raises :class:`QueueFullError` at
         ``max_queue`` per the admission policy.
+
+        With ``FleetConfig.qos`` armed, ``priority`` names the
+        request's class (default ``qos.default_priority``) and
+        ``tenant`` is charged the request's token cost — prompt plus
+        decode budget — against its token-bucket quota, rejecting with
+        :class:`~cloud_tpu.serving.QuotaExceededError` BEFORE the
+        request costs anyone else queue position.
         """
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if self._qos is not None:
+            priority = self._qos.resolve_priority(priority)
+        else:
+            priority = qos_lib.validate_priority(priority)
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
             raise ValueError(
                 f"prompt must be 1-D token ids, got shape {prompt.shape}"
             )
+        bucket = None
+        cost = 0
+        if self._qos is not None and tenant is not None:
+            # One cost definition (qos.request_cost) for quota and
+            # fairness both: prompt + decode budget, with an omitted
+            # budget charged at unbudgeted_decode_cost — never free.
+            cost = self._qos.request_cost(
+                int(prompt.shape[0]), max_new_tokens
+            )
+            bucket = self._tenant_bucket(tenant)
+            if bucket is not None and not bucket.try_acquire(cost):
+                with self._stats_lock:
+                    self._stats["quota_rejected"] += 1
+                metrics.counter_inc("fleet/quota_rejected")
+                raise QuotaExceededError(
+                    f"tenant {tenant!r} quota exhausted: request costs "
+                    f"{cost} tokens, {bucket.available():.0f} available "
+                    f"(refill {bucket.quota.tokens_per_s}/s, burst "
+                    f"{bucket.quota.burst_tokens})"
+                )
         submitted = time.perf_counter()
+        token_stream = TokenStream() if stream else None
         request = _FleetRequest(
             prompt=prompt, max_new_tokens=max_new_tokens, future=Future(),
             submitted=submitted,
@@ -426,32 +528,64 @@ class Fleet:
             affinity_key=hash(
                 tuple(int(t) for t in prompt[:AFFINITY_PREFIX_TOKENS])
             ),
+            priority=priority, tenant=tenant, stream=token_stream,
         )
+        if token_stream is not None:
+            # Every fleet resolution path goes through the future; the
+            # callback closes the stream with the re-based result (or
+            # the typed failure) and back-fills undelivered tokens.
+            request.future.add_done_callback(
+                token_stream._complete_from_future
+            )
         cfg = self.config
-        with self._cond:
-            if self._closed:
-                raise FleetClosedError("fleet is closed")
-            if len(self._queue) >= cfg.max_queue:
-                if cfg.admission == "reject":
-                    with self._stats_lock:
-                        self._stats["rejected"] += 1
-                    metrics.counter_inc("fleet/rejected")
-                    raise QueueFullError(
-                        f"fleet queue full ({cfg.max_queue} waiting); "
-                        "retry with backoff or raise max_queue/max_replicas"
-                    )
-                while len(self._queue) >= cfg.max_queue and not self._closed:
-                    self._cond.wait()
+        try:
+            with self._cond:
                 if self._closed:
-                    raise FleetClosedError(
-                        "fleet closed while blocked on admission"
-                    )
-            self._queue.append(request)
-            self._cond.notify_all()
+                    raise FleetClosedError("fleet is closed")
+                if len(self._queue) >= cfg.max_queue:
+                    if cfg.admission == "reject":
+                        with self._stats_lock:
+                            self._stats["rejected"] += 1
+                        metrics.counter_inc("fleet/rejected")
+                        raise QueueFullError(
+                            f"fleet queue full ({cfg.max_queue} waiting); "
+                            "retry with backoff or raise "
+                            "max_queue/max_replicas"
+                        )
+                    while (len(self._queue) >= cfg.max_queue
+                           and not self._closed):
+                        self._cond.wait()
+                    if self._closed:
+                        raise FleetClosedError(
+                            "fleet closed while blocked on admission"
+                        )
+                self._queue.append(request)
+                self._cond.notify_all()
+        except (QueueFullError, FleetClosedError):
+            # The request never entered the queue: refund its quota
+            # charge — burning tokens on work the fleet refused would
+            # quota-block the tenant for service it never received.
+            if bucket is not None:
+                bucket.credit(cost)
+            raise
         with self._stats_lock:
             self._stats["submitted"] += 1
         metrics.counter_inc("fleet/requests")
-        return request.future
+        return token_stream if token_stream is not None else request.future
+
+    def _tenant_bucket(self, tenant: str) -> Optional[TokenBucket]:
+        """The tenant's token bucket (lazily built; ``None`` when the
+        tenant has no configured quota and there is no default)."""
+        with self._buckets_lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                quota = self._qos.quotas.get(
+                    tenant, self._qos.default_quota
+                )
+                if quota is None:
+                    return None
+                bucket = self._buckets[tenant] = TokenBucket(quota)
+            return bucket
 
     # -- router ------------------------------------------------------------
 
@@ -463,8 +597,10 @@ class Fleet:
                     while True:
                         now = time.perf_counter()
                         self._shed_expired_locked(now)
+                        if self._qos_sched is not None:
+                            self._shed_brownout_locked(now)
                         if self._queue:
-                            request = self._queue.popleft()
+                            request = self._pop_request_locked(now)
                             # In flight from the POP: a draining close()
                             # waits on queue+in_flight, and a request
                             # mid-routing belongs to neither otherwise.
@@ -492,6 +628,63 @@ class Fleet:
             r.deadline for r in self._queue if r.deadline is not None
         ]
         return min(deadlines) if deadlines else None
+
+    def _pop_request_locked(self, now: float) -> _FleetRequest:
+        """Take the next request to route (caller holds the lock and
+        guarantees a non-empty queue): FIFO without QoS — byte-identical
+        to the pre-QoS fleet — else the (SLO slack, weighted fairness
+        debt) order over the whole fleet queue, charged to the class's
+        fairness debt at the pop."""
+        if self._qos_sched is None:
+            return self._queue.popleft()
+        best = self._qos_sched.select(self._queue, now)
+        self._queue.remove(best)
+        if not best.charged:
+            # Same cost definition as the quota (qos.request_cost), and
+            # charged ONCE — a failover re-entry already paid.
+            best.charged = True
+            self._qos_sched.charge(
+                best.priority,
+                self._qos.request_cost(
+                    int(best.prompt.shape[0]), best.max_new_tokens
+                ),
+            )
+        return best
+
+    def _shed_brownout_locked(self, now: float) -> int:
+        """Fleet-level class-aware brownout (caller holds the lock;
+        no-op unless ``qos.brownout_queue_depth`` is armed): while the
+        fleet queue exceeds the depth, shed the LOWEST-weight class
+        first, newest first within a class, typed
+        :class:`BrownoutShedError` — batch sheds before interactive."""
+        if (self._qos is None
+                or self._qos.brownout_queue_depth is None
+                or len(self._queue) <= self._qos.brownout_queue_depth):
+            return 0
+        excess = len(self._queue) - self._qos.brownout_queue_depth
+        # ONE shed-order definition for both schedulers (qos_lib owns
+        # the policy; this method owns the fleet's queue mechanics).
+        victims = qos_lib.brownout_victims(self._queue, excess, self._qos)
+        shed = 0
+        for request in victims:
+            self._queue.remove(request)
+            shed += 1
+            tracing.record_span(
+                "fleet/shed", request.submitted, now,
+                reason="brownout", priority=request.priority,
+            )
+            self._resolve(request, exc=BrownoutShedError(
+                f"request shed under brownout: fleet queue exceeded "
+                f"brownout_queue_depth="
+                f"{self._qos.brownout_queue_depth} and "
+                f"{request.priority!r} is the lowest class still queued"
+            ), shed=True)
+            with self._stats_lock:
+                self._stats["brownout_shed"] += 1
+        if shed:
+            metrics.counter_inc("fleet/brownout_shed", shed)
+            self._cond.notify_all()
+        return shed
 
     def _shed_expired_locked(self, now: float) -> int:
         """Fleet-level deadline shedding: an expired request leaves the
@@ -545,15 +738,14 @@ class Fleet:
                 if self._closed and not self._draining:
                     raise FleetClosedError("fleet closed during routing")
                 candidates = list(self._replicas)
+            pick_kwargs = {}
             if self._pick_takes_affinity:
-                replica, health = self._router.pick(
-                    candidates, exclude=tried,
-                    affinity_key=request.affinity_key,
-                )
-            else:
-                replica, health = self._router.pick(
-                    candidates, exclude=tried
-                )
+                pick_kwargs["affinity_key"] = request.affinity_key
+            if self._pick_takes_priority and request.priority is not None:
+                pick_kwargs["priority"] = request.priority
+            replica, health = self._router.pick(
+                candidates, exclude=tried, **pick_kwargs
+            )
             if replica is None:
                 tried.clear()  # widen the next pass: a restarted or
                 # previously-full replica deserves a fresh look.
@@ -565,11 +757,22 @@ class Fleet:
                 raise DeadlineExceededError(
                     "request expired while routing"
                 )
+            # Priority and the stream's per-token forward ride along
+            # only when set, so duck-typed engines predating the QoS
+            # kwargs keep working on the plain path.  The stream feed
+            # is idempotent by index: a failover re-run's deterministic
+            # greedy tokens resume it without duplicates.
+            extra = {}
+            if request.priority is not None:
+                extra["priority"] = request.priority
+            if request.stream is not None:
+                extra["on_token"] = request.stream.feed
             try:
                 inner = replica.engine.submit(
                     request.prompt,
                     max_new_tokens=request.max_new_tokens,
                     deadline_s=remaining,
+                    **extra,
                 )
             except (QueueFullError, EngineClosedError) as exc:
                 # This candidate is out; fail over to the next one.
@@ -602,6 +805,8 @@ class Fleet:
             "load": Replica.load_of(health),
             "attempt": request.attempts,
         }
+        if request.priority is not None:
+            span_attrs["priority"] = request.priority
         occupancy = Replica.occupancy_of(health)
         if occupancy is not None:
             span_attrs["occupancy"] = round(occupancy, 4)
@@ -661,14 +866,31 @@ class Fleet:
             if isinstance(result, ServeResult):
                 # Latency the caller actually saw: fleet submit -> done
                 # (includes fleet queueing, routing, and any failover).
+                # TTFT re-bases the same way: the engine measured
+                # engine-submit -> first token, so the first-token
+                # instant is ``done - (latency - ttft)`` and the fleet
+                # TTFT adds the fleet queueing/routing in front of it —
+                # the number the QoS classes' SLOs are judged by.
+                fleet_latency = time.perf_counter() - request.submitted
                 result = dataclasses.replace(
                     result,
-                    latency_seconds=time.perf_counter() - request.submitted,
+                    latency_seconds=fleet_latency,
+                    ttft_seconds=max(
+                        fleet_latency - (
+                            result.latency_seconds - result.ttft_seconds
+                        ),
+                        0.0,
+                    ),
                 )
             self._resolve(request, result=result)
             return
-        if isinstance(exc, DeadlineExceededError):
-            # The replica shed it: the deadline verdict stands.
+        if isinstance(exc, (DeadlineExceededError, BrownoutShedError)):
+            # The replica shed it: the deadline/brownout verdict stands
+            # (re-submitting a brownout shed into the same overload
+            # would amplify it).
+            if isinstance(exc, BrownoutShedError):
+                with self._stats_lock:
+                    self._stats["brownout_shed"] += 1
             self._resolve(request, exc=exc, shed=True)
             return
         if request.expired(now):
@@ -697,6 +919,11 @@ class Fleet:
                 self._stats["shed"] += 1
             else:
                 self._stats["failed"] += 1
+            if self._qos is not None and request.priority is not None:
+                if exc is None:
+                    self._class_completed[request.priority] += 1
+                elif shed:
+                    self._class_shed[request.priority] += 1
         if exc is None:
             metrics.counter_inc("fleet/completed")
         elif not shed:
@@ -721,6 +948,7 @@ class Fleet:
         with self._cond:
             replicas = list(self._replicas)
             queue_depth = len(self._queue)
+            class_backlog = self._class_backlog_locked()
         ready = 0
         busy_slots = 0
         total_slots = 0
@@ -753,15 +981,27 @@ class Fleet:
                 # signal that only watched the fleet queue would read a
                 # saturated fleet as idle.
                 queue_depth += int(health.get("queue_depth") or 0)
+                # Same totality for the per-class signal: a QoS engine's
+                # own queue carries classes the fleet queue already
+                # drained into it.
+                for name, count in (
+                    health.get("class_backlog") or {}
+                ).items():
+                    if name in class_backlog:
+                        class_backlog[name] += int(count or 0)
         occupancy = busy_slots / total_slots if total_slots else 0.0
         metrics.gauge_set("fleet/replicas", len(replicas))
         metrics.gauge_set("fleet/queue_depth", queue_depth)
         metrics.gauge_set("fleet/occupancy", occupancy)
+        if self._qos is not None:
+            for name, count in class_backlog.items():
+                metrics.gauge_set(f"fleet/class_{name}_backlog", count)
         if self._closed:
             return  # draining: capacity is frozen, only health matters
         decision = self._autoscaler.observe(
             queue_depth=queue_depth, ready_replicas=ready,
             occupancy=occupancy,
+            class_backlog=class_backlog if self._qos is not None else None,
         )
         if decision == "up":
             self._scale_up()
@@ -902,7 +1142,12 @@ class Fleet:
             in_flight = self._in_flight
             closed = self._closed
             replicas = list(self._replicas)
+            class_backlog = self._class_backlog_locked()
         snapshots = [r.health() for r in replicas]
+        for snap in snapshots:
+            for name, count in (snap.get("class_backlog") or {}).items():
+                if name in class_backlog:
+                    class_backlog[name] += int(count or 0)
         ready = sum(
             1 for r, h in zip(replicas, snapshots) if r.routable(h)
         )
@@ -923,7 +1168,20 @@ class Fleet:
             ),
             "queue_depth": queue_depth,
             "in_flight": in_flight,
+            # Per-class backlog — fleet queue plus every ready
+            # replica's own (QoS engines carry theirs in health()).
+            # All-zeros when QoS is off — stable schema.
+            "class_backlog": class_backlog,
         }
+
+    def _class_backlog_locked(self) -> Dict[str, int]:
+        """Fleet-queue requests per QoS class (caller holds ``_cond``).
+        Zeros for every class when QoS is off."""
+        backlog = {name: 0 for name in self._class_names}
+        if self._qos is not None:
+            for request in self._queue:
+                backlog[request.priority] += 1
+        return backlog
 
     def stats(self) -> dict:
         """Counters snapshot plus per-replica routed counts (replica id
@@ -931,5 +1189,8 @@ class Fleet:
         with self._stats_lock:
             snap = dict(self._stats)
             snap["routed"] = dict(self._routed)
+            # Per-class service accounting (zeros when QoS is off).
+            snap["class_completed"] = dict(self._class_completed)
+            snap["class_shed"] = dict(self._class_shed)
         snap["replicas"] = self.num_replicas()
         return snap

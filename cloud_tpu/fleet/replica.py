@@ -129,6 +129,7 @@ class Replica:
                 "reason": f"replica {self.state}", "queue_depth": 0,
                 "active_slots": 0, "num_slots": 0,
                 "slice_shape": (0, 0), "slice_chips": 0,
+                "class_backlog": {},
                 "replica": self.id, "state": self.state,
             }
         snap = engine.health()

@@ -26,12 +26,27 @@ balancing contract is unchanged.
 pass — a replica that just raised ``QueueFullError`` must not be picked
 again until every other candidate had its chance (the fleet clears the
 set once it round-robins through everyone).
+
+**QoS-aware load** (``class_weights=...``): a QoS fleet's replicas run
+priority schedulers, so a deep *batch* backlog delays an arriving
+*interactive* request far less than the raw queue depth suggests — the
+engine will admit the interactive request past it.  With a class-weight
+map (normally ``{name: cls.weight for ...}`` from the fleet's
+``QosConfig``), the load signal discounts backlog BELOW the arriving
+request's class by the weight ratio::
+
+    load = active_slots + sum_c backlog_c * min(1, w_c / w_request)
+
+Same-or-higher classes count in full (they genuinely queue ahead).
+Replicas without a per-class backlog in ``health()``, requests without
+a priority, and routers without the map all fall back to the plain
+``queue + active`` signal — the default contract is unchanged.
 """
 
 from __future__ import annotations
 
 import collections
-from typing import Iterable, Optional, Tuple
+from typing import Iterable, Mapping, Optional, Tuple
 
 from cloud_tpu.fleet.replica import Replica
 
@@ -44,11 +59,13 @@ class LeastLoadedRouter:
     them (LRU-bounded — the map must not grow with unique-traffic
     volume).  The fleet passes each request's ``affinity_key`` (a hash
     of its leading tokens) through :meth:`pick`; callers that pass
-    ``None`` get the plain lowest-id tie-break.
+    ``None`` get the plain lowest-id tie-break.  ``class_weights``
+    arms the QoS-aware load discount (module docstring).
     """
 
     def __init__(self, prefix_affinity: bool = False,
-                 affinity_capacity: int = 1024):
+                 affinity_capacity: int = 1024,
+                 class_weights: Optional[Mapping[str, float]] = None):
         if affinity_capacity < 1:
             raise ValueError(
                 f"affinity_capacity must be >= 1, got {affinity_capacity}"
@@ -57,24 +74,55 @@ class LeastLoadedRouter:
             collections.OrderedDict() if prefix_affinity else None
         )
         self._affinity_capacity = affinity_capacity
+        if class_weights is not None:
+            class_weights = dict(class_weights)
+            for name, weight in class_weights.items():
+                if weight <= 0:
+                    raise ValueError(
+                        f"class_weights[{name!r}] must be > 0, "
+                        f"got {weight}"
+                    )
+        self._class_weights = class_weights
+
+    def _load_for(self, health: dict,
+                  priority: Optional[str]) -> float:
+        """The candidate's load as seen by THIS request: plain
+        ``queue + active`` unless the QoS discount applies."""
+        weights = self._class_weights
+        backlog = health.get("class_backlog")
+        if (weights is None or priority is None
+                or priority not in weights or not backlog):
+            return float(Replica.load_of(health))
+        w_req = weights[priority]
+        classed = 0
+        load = float(int(health.get("active_slots") or 0))
+        for name, count in backlog.items():
+            count = int(count or 0)
+            classed += count
+            load += count * min(1.0, weights.get(name, w_req) / w_req)
+        # Queue depth beyond the classed backlog (a replica whose own
+        # QoS is off reports zeros): count it in full.
+        load += max(int(health.get("queue_depth") or 0) - classed, 0)
+        return load
 
     def pick(self, replicas: Iterable[Replica],
              exclude: Iterable[int] = (),
              affinity_key: Optional[int] = None,
+             priority: Optional[str] = None,
              ) -> Tuple[Optional[Replica], Optional[dict]]:
         """Return ``(replica, its health snapshot)`` or ``(None, None)``
         when no routable candidate exists (all excluded, draining,
         restarting, or unhealthy)."""
         excluded = set(exclude)
         tied: list = []  # (replica, health) rows at the best load
-        best_load: Optional[int] = None
+        best_load: Optional[float] = None
         for replica in replicas:
             if replica.id in excluded:
                 continue
             health = replica.health()
             if not replica.routable(health):
                 continue
-            load = Replica.load_of(health)
+            load = self._load_for(health, priority)
             if best_load is None or load < best_load:
                 tied = [(replica, health)]
                 best_load = load

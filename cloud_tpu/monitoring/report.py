@@ -29,6 +29,13 @@ dispatch count, the draft-token acceptance rate (from the
 and the draft-vs-verify wall-clock split — the numbers ``spec_k`` is
 tuned against, printed next to the occupancy line.
 
+QoS timelines (``serve/request`` spans — the engine stamps one per
+retired request when ``ServeConfig.qos`` is armed, carrying
+``priority`` and ``ttft_s`` attributes) get a **QoS classes** section:
+per-class request counts with TTFT and end-to-end latency p50/p99 —
+the per-class SLO numbers the priority weights and quotas are tuned
+against.  FIFO timelines carry no such spans and render no section.
+
 Timelines with ``fleet/*`` spans (the ``cloud_tpu.fleet`` layer) get a
 **fleet** section: per-replica routed-request counts with mean
 load/occupancy (from the attributes the router stamps on every
@@ -343,6 +350,46 @@ class TraceReport:
             "rollbacks": rollbacks,
         }
 
+    def qos_summary(self) -> Optional[Dict[str, object]]:
+        """Aggregate the per-request QoS spans into a per-class SLO
+        table.
+
+        ``serve/request`` spans exist only on QoS-armed engines (one
+        per retired request, duration = end-to-end latency, ``ttft_s``
+        attribute = submit -> first token); grouping by the
+        ``priority`` attribute yields per-class request counts and
+        TTFT / latency p50/p99 — the numbers class weights, SLO
+        targets, and quotas are tuned against.  None when the timeline
+        has no QoS spans (FIFO engine, or a non-serving trace).
+        """
+        by_class: Dict[str, Dict[str, List[float]]] = {}
+        for event in self.events:
+            if event.get("name") != "serve/request":
+                continue
+            args = event.get("args") or {}
+            name = str(args.get("priority") or "?")
+            row = by_class.setdefault(
+                name, {"ttft": [], "latency": []}
+            )
+            row["latency"].append(event["dur"] / 1e6)
+            ttft = args.get("ttft_s")
+            if isinstance(ttft, (int, float)):
+                row["ttft"].append(float(ttft))
+        if not by_class:
+            return None
+        classes = {}
+        for name, row in by_class.items():
+            ttft = sorted(row["ttft"])
+            latency = sorted(row["latency"])
+            classes[name] = {
+                "requests": len(latency),
+                "ttft_p50_s": _percentile(ttft, 0.5) if ttft else None,
+                "ttft_p99_s": _percentile(ttft, 0.99) if ttft else None,
+                "latency_p50_s": _percentile(latency, 0.5),
+                "latency_p99_s": _percentile(latency, 0.99),
+            }
+        return {"classes": classes}
+
     def fleet_summary(self) -> Optional[Dict[str, object]]:
         """Aggregate the serving-fleet spans into one operations dict.
 
@@ -532,6 +579,23 @@ class TraceReport:
                     f"  occupancy spread across replicas: "
                     f"{fleet['occupancy_spread']:.1%}"
                 )
+        qos = self.qos_summary()
+        if qos:
+            lines.append("")
+            lines.append("QoS classes (per-class TTFT / latency):")
+            for name in sorted(qos["classes"]):
+                row = qos["classes"][name]
+                detail = f"  {name}: {row['requests']} request(s)"
+                if row["ttft_p50_s"] is not None:
+                    detail += (
+                        f", ttft p50 {_fmt_s(row['ttft_p50_s'])} / "
+                        f"p99 {_fmt_s(row['ttft_p99_s'])}"
+                    )
+                detail += (
+                    f", latency p50 {_fmt_s(row['latency_p50_s'])} / "
+                    f"p99 {_fmt_s(row['latency_p99_s'])}"
+                )
+                lines.append(detail)
         continuous = self.continuous_summary()
         if continuous:
             parts = [f"{continuous['chunks']} chunks"]

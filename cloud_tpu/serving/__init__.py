@@ -26,18 +26,36 @@ from cloud_tpu.serving.engine import (
     SERVE_SCHEDULER_THREAD_NAME,
 )
 from cloud_tpu.serving.prefix_cache import PrefixCacheManager, PrefixHit
+from cloud_tpu.serving.qos import (
+    BrownoutShedError,
+    PriorityClass,
+    QosConfig,
+    QosScheduler,
+    QuotaExceededError,
+    TenantQuota,
+    TokenBucket,
+    TokenStream,
+)
 
 __all__ = [
+    "BrownoutShedError",
     "DeadlineExceededError",
     "DispatchTimeoutError",
     "DraftConfig",
     "EngineClosedError",
     "PrefixCacheManager",
     "PrefixHit",
+    "PriorityClass",
+    "QosConfig",
+    "QosScheduler",
     "QueueFullError",
+    "QuotaExceededError",
     "ServeConfig",
     "ServeResult",
     "ServingEngine",
+    "TenantQuota",
+    "TokenBucket",
+    "TokenStream",
     "SERVE_DISPATCH_THREAD_NAME",
     "SERVE_SCHEDULER_THREAD_NAME",
 ]

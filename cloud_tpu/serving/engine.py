@@ -124,6 +124,12 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from cloud_tpu.monitoring import metrics, tracing
+from cloud_tpu.serving import qos as qos_lib
+from cloud_tpu.serving.qos import (
+    BrownoutShedError,
+    QosConfig,
+    TokenStream,
+)
 from cloud_tpu.utils import faults
 
 logger = logging.getLogger(__name__)
@@ -288,6 +294,14 @@ class ServeConfig:
     #: speed; a budget picks the NARROWEST tp that fits, leaving chips
     #: for more replicas.
     hbm_bytes_per_chip: Optional[int] = None
+    #: Multi-tenant QoS (continuous mode): ``serving.qos.QosConfig``
+    #: arms priority classes (slot admission by SLO slack + weighted
+    #: fairness debt instead of arrival order) and class-aware brownout
+    #: shedding.  ``None`` (default) keeps the FIFO path byte-identical
+    #: — priority tags are accepted but never reorder anything, and the
+    #: per-class health/stats keys read zero.  Host-side policy only:
+    #: the compiled programs are untouched either way.
+    qos: Optional[QosConfig] = None
 
     def __post_init__(self):
         from cloud_tpu.models.generation import SampleConfig
@@ -380,6 +394,19 @@ class ServeConfig:
                 f"dispatch_timeout_s must be > 0 or None, "
                 f"got {self.dispatch_timeout_s}"
             )
+        if self.qos is not None:
+            if not isinstance(self.qos, QosConfig):
+                raise ValueError(
+                    f"qos must be a serving.qos.QosConfig, got "
+                    f"{type(self.qos).__name__}"
+                )
+            if self.scheduler != "continuous":
+                raise ValueError(
+                    "qos= (priority scheduling) needs the continuous "
+                    "scheduler — slot admission is where the class "
+                    "order is enforced; the batch path forms batches "
+                    "by bucket, not by request"
+                )
         if self.layout not in ("explicit", "auto"):
             raise ValueError(
                 f"layout must be 'explicit' or 'auto', got {self.layout!r}"
@@ -426,7 +453,10 @@ class ServeResult:
     ttft_seconds: float = 0.0
 
 
-@dataclasses.dataclass
+#: eq=False: requests are removed from mid-queue by IDENTITY (QoS
+#: admission, brownout shed) — a generated __eq__ would compare numpy
+#: prompt arrays element-wise and raise on the first non-match.
+@dataclasses.dataclass(eq=False)
 class _Request:
     prompt: np.ndarray
     prompt_len: int
@@ -437,6 +467,16 @@ class _Request:
     #: Absolute perf_counter time after which the request is shed from
     #: the queue instead of served (None: wait forever).
     deadline: Optional[float] = None
+    #: QoS class name (resolved at submit when a QosConfig is armed;
+    #: carried-but-inert on the FIFO path).
+    priority: Optional[str] = None
+    #: Per-token delivery (``submit(stream=True)``): fed from the
+    #: emission path as chunks commit, closed by the future's
+    #: done-callback.  None for plain futures.
+    stream: Optional[TokenStream] = None
+    #: Cross-layer per-token hook (the fleet's stream forwarding):
+    #: called as ``on_token(index, token)`` from the scheduler thread.
+    on_token: Optional[object] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -458,6 +498,9 @@ class _Slot:
     tokens: List[int]
     prefix_nodes: List[object] = dataclasses.field(default_factory=list)
     first_token_ts: Optional[float] = None
+    #: Tokens already delivered to the request's stream/on_token hook
+    #: (prefix of ``tokens``, capped at the request's budget).
+    streamed: int = 0
 
 
 @dataclasses.dataclass
@@ -610,7 +653,24 @@ class ServingEngine:
             "spec_proposed": 0, "spec_accepted": 0, "draft_prefills": 0,
             # Robustness counters: queue-shed deadlines, watchdog fires.
             "shed": 0, "watchdog_timeouts": 0,
+            # QoS brownout sheds (0 unless qos arms a brownout depth).
+            "brownout_shed": 0,
         }
+        #: QoS state: None keeps the FIFO path byte-identical (every
+        #: policy branch below checks this).  The scheduler object owns
+        #: the fairness-debt state; per-class counters feed health()/
+        #: stats() (zeros when off — stable schema).
+        self._qos = self.serve_config.qos
+        self._qos_sched = (
+            qos_lib.QosScheduler(self._qos) if self._qos else None
+        )
+        classes = (
+            tuple(self._qos.classes) if self._qos
+            else qos_lib.DEFAULT_PRIORITIES
+        )
+        self._class_names = classes
+        self._class_completed = {c: 0 for c in classes}
+        self._class_shed = {c: 0 for c in classes}
         self._qps = metrics.WindowedRate("serve/qps", window=16)
         self._tokens_rate = metrics.WindowedRate(
             "serve/tokens_per_sec", window=256
@@ -1092,8 +1152,13 @@ class ServingEngine:
         return self.serve_config.prompt_buckets[-1]
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Future:
-        """Enqueue one prompt; returns a Future of :class:`ServeResult`.
+               deadline_s: Optional[float] = None,
+               priority: Optional[str] = None,
+               stream: bool = False,
+               on_token=None) -> Future:
+        """Enqueue one prompt; returns a Future of :class:`ServeResult`
+        (or a :class:`~cloud_tpu.serving.qos.TokenStream` with
+        ``stream=True``).
 
         ``prompt`` is a 1-D int sequence (length 1 ..
         ``prompt_buckets[-1]``).  ``max_new_tokens`` may be below the
@@ -1111,10 +1176,26 @@ class ServingEngine:
         request that reached the device before the deadline runs to
         completion; dispatch is never aborted mid-flight for deadlines
         (that is the watchdog's job, and only for hangs).
+
+        ``priority`` names the request's QoS class: with
+        ``ServeConfig.qos`` armed, slot admission orders by (SLO slack,
+        weighted fairness debt) over these classes and brownout sheds
+        the lowest class first; without it the tag is validated and
+        recorded but never reorders anything (FIFO — byte-identical).
+        ``stream=True`` returns a :class:`~cloud_tpu.serving.qos.
+        TokenStream` fed per emitted token from the chunk-commit path
+        (the batch scheduler delivers at completion); iterating yields
+        the exact tokens the final result row carries.  ``on_token`` is
+        the cross-layer per-token hook the fleet uses to forward a
+        stream — called as ``(index, token)`` on the scheduler thread.
         """
         cfg = self.serve_config
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if self._qos is not None:
+            priority = self._qos.resolve_priority(priority)
+        else:
+            priority = qos_lib.validate_priority(priority)
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
             raise ValueError(
@@ -1134,6 +1215,7 @@ class ServingEngine:
             )
         bucket_len = next(b for b in cfg.prompt_buckets if b >= n)
         submitted = time.perf_counter()
+        token_stream = TokenStream() if stream else None
         request = _Request(
             prompt=prompt, prompt_len=n, max_new_tokens=m,
             bucket_len=bucket_len, future=Future(),
@@ -1141,7 +1223,16 @@ class ServingEngine:
             deadline=(
                 None if deadline_s is None else submitted + deadline_s
             ),
+            priority=priority, stream=token_stream, on_token=on_token,
         )
+        if token_stream is not None:
+            # EVERY resolution path (retire, shed, crash, close) goes
+            # through the future; the callback closes the stream with
+            # the same result/exception and back-fills any tokens the
+            # incremental path did not deliver.
+            request.future.add_done_callback(
+                token_stream._complete_from_future
+            )
         with self._cond:
             if self._closed:
                 raise EngineClosedError("engine is closed")
@@ -1167,7 +1258,7 @@ class ServingEngine:
         with self._stats_lock:
             self._stats["requests"] += 1
         metrics.counter_inc("serve/requests")
-        return request.future
+        return token_stream if token_stream is not None else request.future
 
     # -- warmup ------------------------------------------------------------
 
@@ -1496,6 +1587,7 @@ class ServingEngine:
         slot or a batch row — with a typed failure the caller can
         distinguish from a crash.  Returns the shed count."""
         shed = 0
+        shed_classes: List[str] = []
         for queue_ in self._pending.values():
             if not queue_ or not any(r.expired(now) for r in queue_):
                 continue
@@ -1507,6 +1599,8 @@ class ServingEngine:
                     continue
                 self._waiting -= 1
                 shed += 1
+                if request.priority is not None:
+                    shed_classes.append(request.priority)
                 waited = now - request.submitted
                 tracing.record_span(
                     "serve/shed", request.submitted, now,
@@ -1525,6 +1619,61 @@ class ServingEngine:
             metrics.counter_inc("serve/deadline_exceeded", shed)
             with self._stats_lock:
                 self._stats["shed"] += shed
+                if self._qos is not None:
+                    for name in shed_classes:
+                        self._class_shed[name] += 1
+            self._cond.notify_all()  # admission space freed
+        return shed
+
+    def _shed_brownout_locked(self, now: float) -> int:
+        """Class-aware load shedding (caller holds the lock; no-op
+        unless ``qos.brownout_queue_depth`` is armed): while the waiting
+        set exceeds the brownout depth, shed from the LOWEST-weight
+        class first — newest arrival first within a class, so the
+        requests that waited longest keep their place — with a typed
+        :class:`BrownoutShedError`.  The class-ordered generalization
+        of the deadline shed: batch sheds before interactive."""
+        if (self._qos is None
+                or self._qos.brownout_queue_depth is None
+                or self._waiting <= self._qos.brownout_queue_depth):
+            return 0
+        waiting_at_trigger = self._waiting
+        excess = waiting_at_trigger - self._qos.brownout_queue_depth
+        # ONE shed-order definition for both schedulers (qos_lib owns
+        # the policy; this method owns the engine's queue mechanics).
+        victims = qos_lib.brownout_victims(
+            (r for queue_ in self._pending.values() for r in queue_),
+            excess, self._qos,
+        )
+        shed = 0
+        shed_classes: List[str] = []
+        for request in victims:
+            self._pending[request.bucket_len].remove(request)
+            self._waiting -= 1
+            shed += 1
+            shed_classes.append(request.priority)
+            tracing.record_span(
+                "serve/shed", request.submitted, now,
+                bucket=request.bucket_len, reason="brownout",
+                priority=request.priority,
+            )
+            try:
+                request.future.set_exception(BrownoutShedError(
+                    f"request shed under brownout: {waiting_at_trigger}"
+                    f" waiting > brownout_queue_depth="
+                    f"{self._qos.brownout_queue_depth} and "
+                    f"{request.priority!r} is the lowest class still "
+                    "queued"
+                ))
+            except InvalidStateError:  # pragma: no cover - cancelled
+                pass
+        if shed:
+            metrics.counter_inc("serve/brownout_shed", shed)
+            with self._stats_lock:
+                self._stats["shed"] += shed
+                self._stats["brownout_shed"] += shed
+                for name in shed_classes:
+                    self._class_shed[name] += 1
             self._cond.notify_all()  # admission space freed
         return shed
 
@@ -1756,10 +1905,19 @@ class ServingEngine:
                     self._dispatch_chunk()
 
     def _pop_inserts_locked(self, inserts) -> None:
-        """Claim one free slot per waiting request, oldest submit first
-        across every bucket (FIFO — a minority bucket cannot starve).
-        Caller holds the lock; dispatch happens outside it."""
-        self._shed_expired_locked(time.perf_counter())
+        """Claim one free slot per waiting request — oldest submit first
+        across every bucket (FIFO — a minority bucket cannot starve),
+        or, with QoS armed, by (SLO slack, weighted fairness debt)
+        over the whole waiting set (``qos.QosScheduler``: earliest
+        expiring SLO while slack remains, weighted fair shares once
+        saturation blows every SLO).  Caller holds the lock; dispatch
+        happens outside it."""
+        now = time.perf_counter()
+        self._shed_expired_locked(now)
+        if self._qos_sched is not None:
+            self._shed_brownout_locked(now)
+            self._pop_inserts_qos_locked(inserts, now)
+            return
         popped = False
         while self._free_slots:
             oldest = None
@@ -1776,6 +1934,32 @@ class ServingEngine:
             self._waiting -= 1
             popped = True
             inserts.append((oldest, self._free_slots.pop()))
+        if popped:
+            self._cond.notify_all()  # admission space freed
+
+    def _pop_inserts_qos_locked(self, inserts, now: float) -> None:
+        """The QoS admission order: consider EVERY waiting request
+        (class order is orthogonal to the bucket queues, which exist
+        for compiled-program selection), admit
+        ``QosScheduler.select``'s pick per free slot, and charge the
+        admitted class its fairness debt."""
+        popped = False
+        while self._free_slots:
+            best = self._qos_sched.select(
+                (r for queue_ in self._pending.values() for r in queue_),
+                now,
+            )
+            if best is None:
+                break
+            self._pending[best.bucket_len].remove(best)
+            self._waiting -= 1
+            popped = True
+            self._qos_sched.charge(
+                best.priority,
+                self._qos.request_cost(best.prompt_len,
+                                       best.max_new_tokens),
+            )
+            inserts.append((best, self._free_slots.pop()))
         if popped:
             self._cond.notify_all()  # admission space freed
 
@@ -1919,6 +2103,7 @@ class ServingEngine:
         entry = self._slot_table[slot]
         entry.tokens = [tok0]
         entry.first_token_ts = time.perf_counter()
+        self._feed_entry(entry)
         self._save_prefix_blocks(request, slot, already=task.hit)
         self._activate_or_retire(slot, request, tok0)
 
@@ -2012,10 +2197,12 @@ class ServingEngine:
                 "serve/prefill", dispatch
             )
             tok0 = int(self._to_host("insert_tok0", tok0)[0])
-        self._slot_table[slot] = _Slot(
+        entry = _Slot(
             request=request, tokens=[tok0],
             first_token_ts=time.perf_counter(),
         )
+        self._slot_table[slot] = entry
+        self._feed_entry(entry)
         self._save_prefix_blocks(request, slot)
         self._activate_or_retire(slot, request, tok0)
 
@@ -2057,11 +2244,37 @@ class ServingEngine:
             self._stats["useful_decode_tokens"] += emitted
         self._commit_emissions(toks, valid, chunk)
 
+    def _feed_entry(self, entry: _Slot) -> None:
+        """Deliver a slot's not-yet-streamed emissions to its request's
+        stream / ``on_token`` hook (no-op for plain futures — the FIFO
+        path pays one attribute check).  Capped at the request's budget
+        so the streamed view is exactly the final result row's prefix;
+        the future's done-callback closes the stream and back-fills
+        anything this path never saw (batch scheduler, crash paths)."""
+        request = entry.request
+        if request.stream is None and request.on_token is None:
+            return
+        limit = min(len(entry.tokens), request.max_new_tokens)
+        while entry.streamed < limit:
+            i = entry.streamed
+            token = entry.tokens[i]
+            if request.stream is not None:
+                request.stream.feed(i, token)
+            if request.on_token is not None:
+                try:
+                    request.on_token(i, token)
+                except Exception:  # noqa: BLE001 — a consumer's bug must
+                    # not take the scheduler (and every other slot) down.
+                    logger.exception("on_token hook failed")
+                    request.on_token = None
+            entry.streamed = i + 1
+
     def _commit_emissions(self, toks, valid, width: int) -> None:
         """Mirror one dispatch's [slots, width] emissions into the host
         slot table and retire what finished — shared verbatim by the
         decode-chunk and verify paths (``valid`` is a per-row prefix in
-        both)."""
+        both).  Streaming requests get each committed token the moment
+        it lands here (host-side delivery; the dispatch is unchanged)."""
         eos = self.serve_config.sample.eos_id
         for slot in sorted(self._active_slots):
             entry = self._slot_table[slot]
@@ -2069,6 +2282,7 @@ class ServingEngine:
                 if not valid[slot, i]:
                     break
                 entry.tokens.append(int(toks[slot, i]))
+            self._feed_entry(entry)
             hit_eos = eos is not None and entry.tokens[-1] == eos
             if hit_eos or len(entry.tokens) >= entry.request.max_new_tokens:
                 self._retire_slot(slot)
@@ -2234,6 +2448,17 @@ class ServingEngine:
                 self._stats["expired"] += 1
             self._stats["completed"] += 1
             self._stats["generated_tokens"] += num
+            if self._qos is not None:
+                self._class_completed[request.priority] += 1
+        if self._qos is not None:
+            # Per-request class span (only with QoS armed — a FIFO
+            # timeline keeps its exact pre-QoS span set): report.py's
+            # per-class TTFT/latency breakdown reads these attributes.
+            tracing.record_span(
+                "serve/request", request.submitted, done,
+                priority=request.priority,
+                ttft_s=round(result.ttft_seconds, 6), tokens=num,
+            )
         try:
             request.future.set_result(result)
         except InvalidStateError:  # pragma: no cover - cancelled
@@ -2365,6 +2590,7 @@ class ServingEngine:
             free_slots = (
                 len(self._free_slots) if self._continuous else None
             )
+            class_backlog = self._class_backlog_locked()
         live = thread is not None and thread.is_alive()
         reason = self._unhealthy_reason
         last = self._last_dispatch_ts
@@ -2405,11 +2631,27 @@ class ServingEngine:
             "spec_k": (
                 self.serve_config.draft.spec_k if self._spec else 0
             ),
+            # Per-class queued requests (QoS): all-zeros when qos=None
+            # (requests are classless on the FIFO path) — stable
+            # schema, so the fleet's per-class backlog aggregation and
+            # the autoscaler's class signal read without probing.
+            "class_backlog": class_backlog,
         }
         snap.update(self._prefix_snapshot())
         if self._continuous:
             snap["free_slots"] = free_slots
         return snap
+
+    def _class_backlog_locked(self) -> Dict[str, int]:
+        """Queued requests per QoS class (caller holds ``_cond``).
+        Zeros for every class when QoS is off — the FIFO path never
+        classes its queue."""
+        backlog = {name: 0 for name in self._class_names}
+        if self._qos is not None:
+            for queue_ in self._pending.values():
+                for request in queue_:
+                    backlog[request.priority] += 1
+        return backlog
 
     def _prefix_snapshot(self) -> dict:
         """The three prefix-cache keys ``health()`` and ``stats()`` both
@@ -2440,6 +2682,12 @@ class ServingEngine:
         """
         with self._stats_lock:
             snap = dict(self._stats)
+            # Per-class service accounting (QoS): zeros when qos=None —
+            # stable schema next to brownout_shed above.
+            snap["class_completed"] = dict(self._class_completed)
+            snap["class_shed"] = dict(self._class_shed)
+        with self._cond:
+            snap["class_backlog"] = self._class_backlog_locked()
         snap["mean_batch_occupancy"] = (
             snap["real_rows"] / snap["slots"] if snap["slots"] else 0.0
         )

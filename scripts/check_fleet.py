@@ -21,6 +21,18 @@ parity harness and ``check_chaos.py``'s degradation harness:
    autoscaler must scale up; once the backlog drains and the fleet
    idles, it must drain back down to one replica via graceful drain —
    with every request still served (parity-checked) and zero leaks.
+3. **mixed-tenant QoS** — a saturating batch tenant floods the fleet
+   while an interactive tenant trickles requests in, with the SAME
+   mid-flood replica kill injected into both arms: a FIFO baseline
+   (no QoS anywhere) and a QoS arm (priority classes + engine brownout
+   + a token-bucket quota on the batch tenant).  Asserted: interactive
+   TTFT p99 in the QoS arm beats the FIFO baseline (the whole point of
+   the class scheduler), the batch tenant's quota rejects typed
+   (``QuotaExceededError``) before queueing, brownout sheds BATCH
+   requests only (class-ordered — zero interactive sheds), one
+   streamed interactive request's tokens match its final result row,
+   every completed request has token-for-token greedy parity, every
+   interactive request completes, and zero threads leak.
 
 Prints one JSON line per phase plus a summary::
 
@@ -273,6 +285,237 @@ def check_autoscale(timeout: float) -> dict:
     }
 
 
+def _mixed_tenant_traffic(rng):
+    """One deterministic mixed-tenant workload (shared by both arms so
+    the comparison is like-for-like): a saturating batch flood plus a
+    staggered interactive trickle."""
+    import numpy as np
+
+    # Sized against the CPU rig so the flood actually SATURATES: the
+    # FIFO arm's interactive TTFT must be queue-wait dominated (~2 s,
+    # several times the watchdog+failover delay a killed replica can
+    # add to either arm) for the comparison to be robust — a p99 over
+    # 8 interactive samples is effectively a max, so the FIFO floor
+    # must clear the kill-recovery ceiling with margin.
+    batch_n, interactive_n = 96, 8
+    batch_prompts = [
+        rng.integers(1, 255, 6).astype(np.int32) for _ in range(batch_n)
+    ]
+    interactive_prompts = [
+        rng.integers(1, 255, 4).astype(np.int32)
+        for _ in range(interactive_n)
+    ]
+    return batch_prompts, 128, interactive_prompts, 4
+
+
+def _run_mixed_tenant_arm(params, config, *, qos_on: bool,
+                          timeout: float) -> dict:
+    """One arm of the mixed-tenant comparison: the SAME traffic and the
+    SAME mid-flood replica kill, with or without the QoS stack.  Returns
+    interactive TTFTs, per-outcome counts, and the parity verdict."""
+    import numpy as np
+
+    from cloud_tpu.fleet import (
+        Fleet,
+        FleetConfig,
+        QosConfig,
+        QuotaExceededError,
+        BrownoutShedError,
+        TenantQuota,
+    )
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils import faults
+
+    batch_prompts, batch_budget, interactive_prompts, inter_budget = (
+        _mixed_tenant_traffic(np.random.default_rng(7))
+    )
+    # Engine-level QoS does the slot-admission reordering (the fleet
+    # queue drains into engine queues under block admission, so THAT is
+    # where interactive must jump the line) and the brownout shedding;
+    # fleet-level QoS enforces the batch tenant's quota.  The brownout
+    # depth sits above the whole interactive trickle but well below the
+    # per-engine batch backlog, so shedding is provably class-ordered.
+    engine_qos = QosConfig(brownout_queue_depth=8) if qos_on else None
+    # A SHORT watchdog: the kill's cost to any single request is
+    # bounded by ~dispatch_timeout_s + failover, which must stay well
+    # under the FIFO flood wait for the TTFT gate to be deterministic.
+    serve = ServeConfig(
+        max_new_tokens=batch_budget, prompt_buckets=(8,),
+        batch_buckets=(1, 2), num_slots=2, chunk_tokens=2,
+        dispatch_timeout_s=0.3, warmup=True, qos=engine_qos,
+    )
+
+    def factory():
+        return ServingEngine(params, config, serve, mesh=None)
+
+    fleet_qos = None
+    if qos_on:
+        # Quota sized to admit ~36 of the 96 batch requests (cost =
+        # 6-token prompt + 128-token budget = 134 each) with a refill
+        # too slow to matter inside the run — well ABOVE the per-engine
+        # brownout depth, so both enforcement layers provably bind.
+        fleet_qos = QosConfig(
+            quotas={"batch-tenant": TenantQuota(
+                tokens_per_s=0.1, burst_tokens=134 * 36,
+            )},
+        )
+    fleet = Fleet(factory, FleetConfig(
+        min_replicas=2, poll_interval_s=0.05, qos=fleet_qos,
+    ))
+    fleet.wait_ready(timeout=timeout)
+    # Warm pass outside the fault plan (phase-1 discipline: the kill
+    # must race decode traffic, not a cold compile).
+    fleet.submit(batch_prompts[0][:4], max_new_tokens=2).result(
+        timeout=timeout
+    )
+
+    quota_rejected = 0
+    outcomes = []  # (prompt, budget, future, class) for parity later
+    stream_handle = None
+    stream_tokens = None
+    plan = [{"site": "serve.chunk", "mode": "hang", "hang_s": 1.0,
+             "nth": 6}]
+    with faults.inject(plan) as active:
+        for prompt in batch_prompts:
+            try:
+                future = fleet.submit(
+                    prompt, max_new_tokens=batch_budget,
+                    priority="batch" if qos_on else None,
+                    tenant="batch-tenant" if qos_on else None,
+                )
+            except QuotaExceededError:
+                quota_rejected += 1
+                continue
+            outcomes.append((prompt, batch_budget, future, "batch"))
+        # The trickle starts immediately, WHILE the flood is queued —
+        # that is the window where FIFO buries interactive traffic.
+        for i, prompt in enumerate(interactive_prompts):
+            if qos_on and i == 0:
+                # One streamed request: its per-token view must equal
+                # its final row (the streaming identity gate).
+                stream_handle = fleet.submit(
+                    prompt, max_new_tokens=inter_budget,
+                    priority="interactive", tenant="chat-tenant",
+                    stream=True,
+                )
+                outcomes.append((prompt, inter_budget,
+                                 stream_handle.future, "interactive"))
+            else:
+                outcomes.append((prompt, inter_budget, fleet.submit(
+                    prompt, max_new_tokens=inter_budget,
+                    priority="interactive" if qos_on else None,
+                    tenant="chat-tenant" if qos_on else None,
+                ), "interactive"))
+            time.sleep(0.01)
+        if stream_handle is not None:
+            stream_tokens = list(stream_handle)  # blocks till complete
+        completed = []
+        brownout_shed = {"batch": 0, "interactive": 0}
+        interactive_ttfts = []
+        interactive_failed = 0
+        for prompt, budget, future, cls in outcomes:
+            try:
+                result = future.result(timeout=timeout)
+            except BrownoutShedError:
+                brownout_shed[cls] += 1
+                continue
+            except Exception:  # noqa: BLE001 — counted, gated below
+                if cls == "interactive":
+                    interactive_failed += 1
+                continue
+            completed.append((prompt, budget, result))
+            if cls == "interactive":
+                interactive_ttfts.append(result.ttft_seconds)
+    stats = fleet.stats()
+    fleet.close()
+    leaked = _fleet_threads()
+
+    mismatches = _parity_mismatches(
+        params, config,
+        [c[0] for c in completed], [c[1] for c in completed],
+        [c[2] for c in completed],
+    )
+    stream_ok = True
+    if stream_handle is not None:
+        result = stream_handle.result(timeout=timeout)
+        want = list(result.tokens[:result.num_generated])
+        stream_ok = stream_tokens == want
+    return {
+        "qos_on": qos_on,
+        "interactive_ttfts": sorted(interactive_ttfts),
+        "interactive_failed": interactive_failed,
+        "quota_rejected": quota_rejected,
+        "brownout_shed": brownout_shed,
+        "completed": len(completed),
+        "mismatches": mismatches,
+        "stream_ok": stream_ok,
+        "faults_fired": active.fired(),
+        "fleet_quota_rejected": stats["quota_rejected"],
+        "class_shed": stats["class_shed"],
+        "restarts": stats["restarts"],
+        "leaked_threads": leaked,
+    }
+
+
+def _p99(sorted_values):
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1,
+              int(0.99 * (len(sorted_values) - 1) + 0.5))
+    return sorted_values[idx]
+
+
+def check_mixed_tenant_qos(timeout: float) -> dict:
+    """Phase 3: the QoS arm must beat the FIFO arm on interactive TTFT
+    p99 under the SAME saturating batch flood and the SAME mid-flood
+    replica kill, while the quota and class-ordered shedding contracts
+    hold and every completed request keeps greedy parity."""
+    config, params = _model()
+    fifo = _run_mixed_tenant_arm(params, config, qos_on=False,
+                                 timeout=timeout)
+    qos = _run_mixed_tenant_arm(params, config, qos_on=True,
+                                timeout=timeout)
+    fifo_p99 = _p99(fifo["interactive_ttfts"])
+    qos_p99 = _p99(qos["interactive_ttfts"])
+    shed = qos["brownout_shed"]
+    ok = (
+        qos_p99 < fifo_p99
+        and fifo["interactive_failed"] == 0
+        and qos["interactive_failed"] == 0
+        and fifo["mismatches"] == 0
+        and qos["mismatches"] == 0
+        and qos["quota_rejected"] >= 1
+        and qos["fleet_quota_rejected"] == qos["quota_rejected"]
+        and shed["batch"] >= 1
+        and shed["interactive"] == 0
+        and qos["class_shed"].get("interactive", 0) == 0
+        and qos["stream_ok"]
+        and fifo["faults_fired"] == {"serve.chunk": 1}
+        and qos["faults_fired"] == {"serve.chunk": 1}
+        and not fifo["leaked_threads"]
+        and not qos["leaked_threads"]
+    )
+    return {
+        "phase": "mixed_tenant_qos",
+        "ok": ok,
+        "fifo_interactive_ttft_p99": round(fifo_p99, 4),
+        "qos_interactive_ttft_p99": round(qos_p99, 4),
+        "quota_rejected": qos["quota_rejected"],
+        "brownout_shed": shed,
+        "class_shed": qos["class_shed"],
+        "stream_ok": qos["stream_ok"],
+        "mismatches": fifo["mismatches"] + qos["mismatches"],
+        "interactive_failed": (
+            fifo["interactive_failed"] + qos["interactive_failed"]
+        ),
+        "completed": {"fifo": fifo["completed"], "qos": qos["completed"]},
+        "restarts": {"fifo": fifo["restarts"], "qos": qos["restarts"]},
+        "faults_fired": {"fifo": fifo["faults_fired"],
+                         "qos": qos["faults_fired"]},
+        "leaked_threads": fifo["leaked_threads"] + qos["leaked_threads"],
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--timeout", type=float, default=240.0,
@@ -283,6 +526,7 @@ def main(argv=None) -> int:
     phases = [
         check_churn_with_replica_kill(args.timeout),
         check_autoscale(args.timeout),
+        check_mixed_tenant_qos(args.timeout),
     ]
     for phase in phases:
         print(json.dumps(phase), flush=True)
@@ -294,8 +538,15 @@ def main(argv=None) -> int:
         "restarts": phases[0]["restarts"],
         "scale_ups": phases[1]["scale_ups"],
         "scale_downs": phases[1]["scale_downs"],
+        "qos_ttft_win": (
+            phases[2]["qos_interactive_ttft_p99"]
+            < phases[2]["fifo_interactive_ttft_p99"]
+        ),
+        "quota_rejected": phases[2]["quota_rejected"],
+        "brownout_shed": phases[2]["brownout_shed"],
         "leaked_threads": (
             phases[0]["leaked_threads"] + phases[1]["leaked_threads"]
+            + phases[2]["leaked_threads"]
         ),
         "wall_seconds": round(time.perf_counter() - start, 3),
     }), flush=True)

@@ -431,6 +431,18 @@ def test_child_measures_fused_phase():
     )
 
 
+def test_child_measures_fleet_qps_sweep_phase():
+    """Static check: the open-loop fleet arrival sweep (ISSUE 14 —
+    latency-under-load curves) is wired into the child's phase list,
+    after the single-point fleet probe whose workload it extends."""
+    src = open(os.path.join(REPO, "bench.py")).read()
+    child = src[src.index("def _child_main"):]
+    assert "_measure_fleet_qps_sweep" in child
+    assert child.index("_measure_fleet,") < child.index(
+        "_measure_fleet_qps_sweep"
+    )
+
+
 def test_child_runs_headline_before_gates():
     """Static order check: the child measures ResNet before any gate or
     context phase (VERDICT r3: the GN gate used to run first and a Mosaic

@@ -400,6 +400,21 @@ class TestHealth:
         # zeros whenever draft=None.
         assert health["spec_acceptance_rate"] == 0.0
         assert health["spec_k"] == 0
+        # ISSUE 14: the QoS per-class backlog is schema in BOTH
+        # schedulers — all-zeros whenever qos=None (the FIFO path
+        # never classes its queue, even when requests carry tags).
+        assert health["class_backlog"] == {
+            "interactive": 0, "standard": 0, "batch": 0,
+        }
+
+    def _assert_qos_stats_zero(self, stats):
+        """ISSUE 14: the QoS stats keys are schema in both schedulers —
+        zeros whenever qos=None."""
+        assert stats["brownout_shed"] == 0
+        zeros = {"interactive": 0, "standard": 0, "batch": 0}
+        assert stats["class_completed"] == zeros
+        assert stats["class_shed"] == zeros
+        assert stats["class_backlog"] == zeros
 
     def test_continuous_health_carries_load_signal(self, model):
         config, params = model
@@ -415,6 +430,7 @@ class TestHealth:
             assert health["free_slots"] == serve.num_slots
             engine.submit(np.asarray([1, 2], np.int32)).result(timeout=120)
             self._assert_load_signal(engine.health(), serve)
+            self._assert_qos_stats_zero(engine.stats())
 
     def test_batch_health_carries_load_signal(self, model):
         config, params = model
@@ -433,6 +449,7 @@ class TestHealth:
             assert health["queue_depth"] == 2
             assert health["active_slots"] == 0  # nothing dispatched yet
             assert "free_slots" not in health  # continuous-only key
+            self._assert_qos_stats_zero(engine.stats())
         finally:
             engine.close(drain=False)
 
