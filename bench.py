@@ -102,6 +102,23 @@ SERVE_PREFIX_BLOCKS = 64
 SERVE_PREFIX_BLOCK_TOKENS = 16
 SERVE_PREFILL_CHUNK = 32
 
+#: Tiered prefix-cache probe (ISSUE 15): a shared-prefix FLASH-CROWD
+#: workload — more distinct long system prompts than the HBM pool can
+#: hold at once, cycled so the LRU evicts each hot prefix between its
+#: uses — run twice through otherwise-identical engines: DRAM tier OFF
+#: (an evicted prefix re-prefills cold) vs ON (it demotes to host DRAM
+#: and swaps back in).  Emits TTFT p50/p99 for both arms plus the
+#: swap-in/demotion counts, so the tier's whole claim (TTFT under HBM
+#: pressure) is a per-round before/after number.  On a CPU rig the
+#: delta is a trend number — host<->"device" copies are memcpys — but
+#: the hit-rate split (tier-on serves from cache what tier-off
+#: re-prefills) is exact.
+SERVE_TIER_HEADS = 6
+SERVE_TIER_HBM_BLOCKS = 18       # holds ~3 of the 6 heads' prefixes
+SERVE_TIER_DRAM_BLOCKS = 64      # holds all of them
+SERVE_TIER_REQUESTS = 12         # two eviction cycles over the heads
+SERVE_TIER_NEW_TOKENS = 8
+
 #: Tensor-parallel serving probe: the slot-grid churn workload through a
 #: sharded engine (ServeConfig(mesh_shape=(2, 1))) on a 2-device CPU
 #: mesh, next to the identical single-chip run.  Runs in its OWN child
@@ -155,9 +172,10 @@ FLEET_REPLICAS = 2
 #: TPOT p50/p99; the mixed-class run (QoS armed, alternating
 #: interactive/batch arrivals) additionally emits per-class TTFT p99 —
 #: the curve pair the priority scheduler's whole existence is judged
-#: by.  The low point should ride under capacity, the high point past
-#: it, so the pair brackets the knee.
-FLEET_SWEEP_QPS = (4, 16)
+#: by.  Four points, low to past-saturation, so a round artifact
+#: carries an actual curve with the knee INSIDE it instead of a
+#: two-point bracket (ISSUE 15 satellite; was (4, 16)).
+FLEET_SWEEP_QPS = (2, 4, 8, 16)
 FLEET_SWEEP_REQUESTS = 12
 FLEET_SWEEP_PROMPT_LEN = 32
 FLEET_SWEEP_NEW_TOKENS = 16
@@ -872,6 +890,108 @@ def _measure_serving_prefix(extras):
     )
 
 
+def _measure_serving_prefix_tier(extras):
+    """Host-DRAM prefix tier before/after probe (constants block above):
+    the SAME flash-crowd workload — more hot system prompts than the
+    HBM pool holds, cycled so each one's blocks are evicted between
+    uses — through a tier-off engine (evictions are losses: the next
+    request re-prefills cold) and a tier-on engine (evictions demote
+    to host DRAM and swap back in).  Emits TTFT p50/p99 per arm plus
+    the swap-in/hit accounting, so the tier's claim — TTFT survival
+    under HBM pressure — is a per-round number.
+    """
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils.benchmarking import decode_setup
+
+    import numpy as np
+
+    cfg, params, _, _ = decode_setup(
+        batch_size=SERVE_MAX_BATCH, prompt_len=SERVE_PROMPT_BUCKET
+    )
+    rng = np.random.default_rng(11)
+    head_len = (SERVE_PROMPT_BUCKET * 3) // 4
+    heads = [
+        rng.integers(1, cfg.vocab_size, head_len).astype(np.int32)
+        for _ in range(SERVE_TIER_HEADS)
+    ]
+    prompts = []
+    for i in range(SERVE_TIER_REQUESTS):
+        tail = rng.integers(
+            1, cfg.vocab_size, int(rng.integers(1, 9))
+        ).astype(np.int32)
+        # Cycle the heads: each one's reuse distance exceeds the HBM
+        # pool, so the LRU has always evicted it again by its next use.
+        prompts.append(np.concatenate([
+            heads[i % SERVE_TIER_HEADS], tail
+        ]))
+
+    def crowd(dram_blocks):
+        serve = ServeConfig(
+            max_new_tokens=SERVE_TIER_NEW_TOKENS,
+            prompt_buckets=(SERVE_PROMPT_BUCKET,),
+            num_slots=2,
+            chunk_tokens=SERVE_CHURN_CHUNK,
+            prefix_cache_blocks=SERVE_TIER_HBM_BLOCKS,
+            prefix_block_tokens=SERVE_PREFIX_BLOCK_TOKENS,
+            prefill_chunk_tokens=SERVE_PREFILL_CHUNK,
+            prefix_dram_blocks=dram_blocks,
+            warmup=True,
+        )
+        with ServingEngine(params, cfg, serve, mesh=None) as engine:
+            engine.wait_ready()
+            # Seed every head once (outside the measurement): the crowd
+            # then measures REUSE under eviction pressure, not first
+            # contact.
+            for head in heads:
+                engine.submit(
+                    np.concatenate([head, head[:1]]), max_new_tokens=2
+                ).result()
+            warm = engine.stats()
+            futures = []
+            for i, prompt in enumerate(prompts):
+                futures.append(engine.submit(prompt))
+                if (i + 1) % 4 == 0:
+                    time.sleep(0.02)  # staggered waves, not one burst
+            results = [f.result() for f in futures]
+            stats = engine.stats()
+        ttfts = sorted(r.ttft_seconds for r in results)
+        return ttfts, warm, stats
+
+    off_ttfts, off_warm, off_stats = crowd(0)
+    on_ttfts, on_warm, on_stats = crowd(SERVE_TIER_DRAM_BLOCKS)
+    extras["serve_prefix_tier_off_ttft_p50_seconds"] = round(
+        _latency_pct(off_ttfts, 0.5), 4
+    )
+    extras["serve_prefix_tier_off_ttft_p99_seconds"] = round(
+        _latency_pct(off_ttfts, 0.99), 4
+    )
+    extras["serve_prefix_tier_on_ttft_p50_seconds"] = round(
+        _latency_pct(on_ttfts, 0.5), 4
+    )
+    extras["serve_prefix_tier_on_ttft_p99_seconds"] = round(
+        _latency_pct(on_ttfts, 0.99), 4
+    )
+    extras["serve_prefix_tier_off_hit_tokens"] = (
+        off_stats["prefix_hit_tokens"] - off_warm["prefix_hit_tokens"]
+    )
+    extras["serve_prefix_tier_on_hit_tokens"] = (
+        on_stats["prefix_hit_tokens"] - on_warm["prefix_hit_tokens"]
+    )
+    extras["serve_prefix_tier_swapin_hits"] = (
+        on_stats["prefix_dram_hits"] - on_warm["prefix_dram_hits"]
+    )
+    extras["serve_prefix_tier_demotions"] = (
+        on_stats["prefix_dram_demotions"]
+        - on_warm["prefix_dram_demotions"]
+    )
+    extras["serve_prefix_tier_config"] = (
+        f"SMALL slots2 hbm{SERVE_TIER_HBM_BLOCKS}"
+        f"x{SERVE_PREFIX_BLOCK_TOKENS} dram{SERVE_TIER_DRAM_BLOCKS} "
+        f"heads{SERVE_TIER_HEADS}x{head_len} n{SERVE_TIER_REQUESTS} "
+        f"pchunk{SERVE_PREFILL_CHUNK}"
+    )
+
+
 def _measure_serving_spec(extras):
     """Speculative-decoding probe (constants block above): the same
     staggered churn through a non-speculative engine, a smaller-draft
@@ -1423,6 +1543,7 @@ def _child_main() -> int:
         (_measure_serving, "serving"),
         (_measure_serving_churn, "serving_churn"),
         (_measure_serving_prefix, "serving_prefix"),
+        (_measure_serving_prefix_tier, "serving_prefix_tier"),
         (_measure_serving_spec, "serving_spec"),
         (_measure_serving_tp, "serving_tp"),
         (_measure_fleet, "fleet"),

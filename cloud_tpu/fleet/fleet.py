@@ -62,6 +62,7 @@ from cloud_tpu.fleet.autoscaler import AutoscaleConfig, QueueDepthAutoscaler
 from cloud_tpu.fleet.replica import Replica
 from cloud_tpu.fleet.router import LeastLoadedRouter
 from cloud_tpu.monitoring import metrics, tracing
+from cloud_tpu.serving import prefix_cache
 from cloud_tpu.serving.engine import (
     DeadlineExceededError,
     EngineClosedError,
@@ -80,11 +81,13 @@ from cloud_tpu.utils import faults, retries
 
 logger = logging.getLogger(__name__)
 
-#: Leading tokens hashed into a request's router affinity key: sized to
-#: cover typical shared system-prompt heads without making every long
-#: unique prompt its own key.  Replicas tie-break toward the replica
-#: whose prefix cache likely holds these tokens' KV (router.py).
-AFFINITY_PREFIX_TOKENS = 32
+#: Leading tokens hashed into a request's router affinity key — ONE
+#: spelling shared with the engines' ``cached_prefixes`` summaries
+#: (serving.prefix_cache defines it), so the cost-model router's
+#: summary lookups and the fleet's request keys can never drift.
+#: Replicas tie-break (and, with ``cache_alpha``, score) toward the
+#: replica whose prefix cache holds these tokens' KV (router.py).
+AFFINITY_PREFIX_TOKENS = prefix_cache.AFFINITY_PREFIX_TOKENS
 
 #: Fleet-owned threads (prefix-matched by the leak guards, same family
 #: as the serving engine's ``cloud-tpu-serve-*`` names).
@@ -525,9 +528,7 @@ class Fleet:
             deadline=(
                 None if deadline_s is None else submitted + deadline_s
             ),
-            affinity_key=hash(
-                tuple(int(t) for t in prompt[:AFFINITY_PREFIX_TOKENS])
-            ),
+            affinity_key=prefix_cache.affinity_key(prompt),
             priority=priority, tenant=tenant, stream=token_stream,
         )
         if token_stream is not None:
@@ -952,8 +953,22 @@ class Fleet:
         ready = 0
         busy_slots = 0
         total_slots = 0
+        dram_blocks = 0
+        dram_demotions = 0
         for replica in replicas:
             health = replica.health()
+            # Tiered-prefix-cache FOOTPRINT (not load): host memory a
+            # replica's DRAM pool holds is held whether or not the
+            # replica is currently routable — a draining replica's
+            # engine keeps its pool until the drain completes, and the
+            # capacity gauge must say so.  Accumulated before the
+            # routable branch below for exactly that reason (zeros
+            # when the tier is off everywhere, and for engineless
+            # replicas via the health stub).
+            dram_blocks += int(health.get("prefix_dram_blocks") or 0)
+            dram_demotions += int(
+                health.get("prefix_dram_demotions") or 0
+            )
             if replica.state == "ready" and not (
                 health.get("healthy") and health.get("live")
             ):
@@ -993,6 +1008,8 @@ class Fleet:
         metrics.gauge_set("fleet/replicas", len(replicas))
         metrics.gauge_set("fleet/queue_depth", queue_depth)
         metrics.gauge_set("fleet/occupancy", occupancy)
+        metrics.gauge_set("fleet/prefix_dram_blocks", dram_blocks)
+        metrics.gauge_set("fleet/prefix_dram_demotions", dram_demotions)
         if self._qos is not None:
             for name, count in class_backlog.items():
                 metrics.gauge_set(f"fleet/class_{name}_backlog", count)

@@ -130,6 +130,17 @@ class Replica:
                 "active_slots": 0, "num_slots": 0,
                 "slice_shape": (0, 0), "slice_chips": 0,
                 "class_backlog": {},
+                # Tiered-prefix-cache schema (an engineless replica
+                # caches nothing): the cost-model router and the
+                # supervisor's dram gauges read these without probing —
+                # the FULL _prefix_snapshot key set, so the stub and a
+                # live engine expose one shape.
+                "prefix_cache_blocks": 0, "prefix_hit_tokens": 0,
+                "evictions": 0, "prefix_dram_blocks": 0,
+                "prefix_dram_hits": 0, "prefix_dram_hit_tokens": 0,
+                "prefix_dram_demotions": 0, "prefix_dram_evictions": 0,
+                "prefix_dram_swapin_failures": 0,
+                "cached_prefixes": {},
                 "replica": self.id, "state": self.state,
             }
         snap = engine.health()

@@ -27,6 +27,28 @@ pass — a replica that just raised ``QueueFullError`` must not be picked
 again until every other candidate had its chance (the fleet clears the
 set once it round-robins through everyone).
 
+**Cache-aware cost model** (``cache_alpha > 0``): replicas export a
+cached-prefix summary in ``health()`` (``cached_prefixes``: affinity
+key -> cached prefix tokens, from the prefix trie's hot roots — both
+tiers, since a host-DRAM-demoted prefix still serves via swap-in), and
+the router scores each candidate as::
+
+    score = load - cache_alpha * expected_cached_prefix_tokens
+
+where the expectation is the candidate summary's entry for THIS
+request's ``affinity_key`` (0 when absent).  Unlike the tie-break,
+this is a real cost model: a replica that caches a long enough prefix
+wins even against a less-loaded cold one, because the prefill compute
+a hit skips is worth ``alpha`` load units per token.  ``alpha``
+calibrates that trade (docs/fleet.md); 0 (the default) disables the
+term entirely — byte-identical to the load-plus-tie-break contract.
+The summary is LIVE (re-read from ``health()`` per decision), so a
+restarted replica's empty cache stops attracting traffic immediately —
+the ``record_affinity`` LRU map can go stale across a failover, which
+is why it remains a tie-break only and never outranks the score.
+The class-weight discount composes: ``load`` above is already the
+QoS-discounted signal when ``class_weights`` is armed.
+
 **QoS-aware load** (``class_weights=...``): a QoS fleet's replicas run
 priority schedulers, so a deep *batch* backlog delays an arriving
 *interactive* request far less than the raw queue depth suggests — the
@@ -60,16 +82,24 @@ class LeastLoadedRouter:
     volume).  The fleet passes each request's ``affinity_key`` (a hash
     of its leading tokens) through :meth:`pick`; callers that pass
     ``None`` get the plain lowest-id tie-break.  ``class_weights``
-    arms the QoS-aware load discount (module docstring).
+    arms the QoS-aware load discount and ``cache_alpha`` the
+    cache-aware cost model (module docstring) — both compose with the
+    affinity tie-break, which only ever picks among score-equals.
     """
 
     def __init__(self, prefix_affinity: bool = False,
                  affinity_capacity: int = 1024,
-                 class_weights: Optional[Mapping[str, float]] = None):
+                 class_weights: Optional[Mapping[str, float]] = None,
+                 cache_alpha: float = 0.0):
         if affinity_capacity < 1:
             raise ValueError(
                 f"affinity_capacity must be >= 1, got {affinity_capacity}"
             )
+        if cache_alpha < 0:
+            raise ValueError(
+                f"cache_alpha must be >= 0, got {cache_alpha}"
+            )
+        self._cache_alpha = float(cache_alpha)
         self._affinity: Optional[collections.OrderedDict] = (
             collections.OrderedDict() if prefix_affinity else None
         )
@@ -105,6 +135,19 @@ class LeastLoadedRouter:
         load += max(int(health.get("queue_depth") or 0) - classed, 0)
         return load
 
+    def _score_for(self, health: dict, priority: Optional[str],
+                   affinity_key: Optional[int]) -> float:
+        """The candidate's routing cost for THIS request: the (QoS-
+        discounted) load minus the cache-awareness credit (module
+        docstring).  With ``cache_alpha == 0`` this IS the load."""
+        score = self._load_for(health, priority)
+        if self._cache_alpha and affinity_key is not None:
+            summary = health.get("cached_prefixes") or {}
+            score -= self._cache_alpha * int(
+                summary.get(affinity_key) or 0
+            )
+        return score
+
     def pick(self, replicas: Iterable[Replica],
              exclude: Iterable[int] = (),
              affinity_key: Optional[int] = None,
@@ -114,19 +157,19 @@ class LeastLoadedRouter:
         when no routable candidate exists (all excluded, draining,
         restarting, or unhealthy)."""
         excluded = set(exclude)
-        tied: list = []  # (replica, health) rows at the best load
-        best_load: Optional[float] = None
+        tied: list = []  # (replica, health) rows at the best score
+        best_score: Optional[float] = None
         for replica in replicas:
             if replica.id in excluded:
                 continue
             health = replica.health()
             if not replica.routable(health):
                 continue
-            load = self._load_for(health, priority)
-            if best_load is None or load < best_load:
+            score = self._score_for(health, priority, affinity_key)
+            if best_score is None or score < best_score:
                 tied = [(replica, health)]
-                best_load = load
-            elif load == best_load:
+                best_score = score
+            elif score == best_score:
                 tied.append((replica, health))
         if not tied:
             return None, None

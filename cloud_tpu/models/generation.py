@@ -923,6 +923,44 @@ def save_prefix_program(pool, cache, slot, block_ids):
     return out
 
 
+def download_prefix_block(pool, block):
+    """One pool block row as a host-transferable pytree: per leaf a
+    ``[L, block_tokens, H, hd]`` slice (k/v, plus the scale leaves of a
+    quantized pool) — the serialization :func:`save_prefix_program`
+    writes, minus the block axis.  The serving engine's host-DRAM
+    prefix tier demotes evicted blocks through this (``np.asarray`` of
+    the result is the DRAM payload) and :func:`upload_prefix_block`
+    restores them; ``block`` is a traced int32 scalar, so ONE
+    executable serves every demotion."""
+    block = jnp.asarray(block, jnp.int32)
+    zero = jnp.int32(0)
+    out = {}
+    for name, leaf in pool.items():
+        l, _, bt, h, w = leaf.shape
+        row = jax.lax.dynamic_slice(
+            leaf, (zero, block, zero, zero, zero), (l, 1, bt, h, w)
+        )
+        out[name] = row[:, 0]
+    return out
+
+
+def upload_prefix_block(pool, payload, block):
+    """The reverse of :func:`download_prefix_block`: write a demoted
+    block's host payload back into pool row ``block`` (a swap-in
+    promotion).  ``payload`` leaves are ``[L, block_tokens, H, hd]``;
+    ``block`` is a traced int32 scalar — one executable serves every
+    swap-in.  Returns the pool."""
+    block = jnp.asarray(block, jnp.int32)
+    zero = jnp.int32(0)
+    out = dict(pool)
+    for name, leaf in pool.items():
+        row = jnp.asarray(payload[name])[:, None]
+        out[name] = jax.lax.dynamic_update_slice(
+            leaf, row.astype(leaf.dtype), (zero, block, zero, zero, zero)
+        )
+    return out
+
+
 def prefill_chunk_program(
     params,
     cache,

@@ -181,14 +181,25 @@ class TraceReport:
         the ``serve/prefill_chunk`` spans — the scheduler interleaves
         exactly one prefill chunk between decode chunks, so a single
         chunk's duration IS the decode stall a long arrival imposes,
-        and the max over chunks is the worst stall of the run.  None
-        when the timeline has neither span (prefix caching and chunked
-        prefill off, batch mode, or a non-serving trace).
+        and the max over chunks is the worst stall of the run.
+
+        The host-DRAM tier (ISSUE 15) shows up two ways: lookup spans
+        stamp ``dram=True`` on hits that needed a swap-in, splitting
+        ``hits`` into ``hbm_hits``/``dram_hits``, and the
+        ``serve/prefix_swapin`` spans carry the swap-in stall the
+        admission path paid to promote demoted blocks (count, total,
+        and max — the worst single admission stall attributable to the
+        tier).  None when the timeline has none of these spans (prefix
+        caching and chunked prefill off, batch mode, or a non-serving
+        trace).
         """
         lookups = 0
         hits = 0
+        dram_hits = 0
         hit_tokens = 0
         chunk_durs: List[float] = []
+        swapin_durs: List[float] = []
+        swapin_blocks = 0
         for event in self.events:
             name = event.get("name", "")
             args = event.get("args") or {}
@@ -196,18 +207,33 @@ class TraceReport:
                 lookups += 1
                 if args.get("hit"):
                     hits += 1
+                    if args.get("dram"):
+                        dram_hits += 1
                 tokens = args.get("hit_tokens")
                 if isinstance(tokens, (int, float)):
                     hit_tokens += int(tokens)
             elif name == "serve/prefill_chunk":
                 chunk_durs.append(event["dur"] / 1e6)
-        if not lookups and not chunk_durs:
+            elif name == "serve/prefix_swapin":
+                swapin_durs.append(event["dur"] / 1e6)
+                blocks = args.get("blocks")
+                if isinstance(blocks, (int, float)):
+                    swapin_blocks += int(blocks)
+        if not lookups and not chunk_durs and not swapin_durs:
             return None
         return {
             "lookups": lookups,
             "hits": hits,
             "hit_rate": hits / lookups if lookups else None,
             "hit_tokens": hit_tokens,
+            "hbm_hits": hits - dram_hits,
+            "dram_hits": dram_hits,
+            "swapins": len(swapin_durs),
+            "swapin_blocks": swapin_blocks,
+            "swapin_seconds": sum(swapin_durs),
+            "max_swapin_stall_seconds": (
+                max(swapin_durs) if swapin_durs else None
+            ),
             "prefill_chunks": len(chunk_durs),
             "prefill_chunk_seconds": sum(chunk_durs),
             "max_decode_stall_seconds": (
@@ -647,6 +673,22 @@ class TraceReport:
             lines.append(
                 "prefix cache: " + (" · ".join(parts) if parts else "off")
             )
+            if prefix["dram_hits"] or prefix["swapins"]:
+                tier_parts = [
+                    f"{prefix['hbm_hits']} hbm hits",
+                    f"{prefix['dram_hits']} dram swap-in hits",
+                ]
+                if prefix["swapins"]:
+                    tier_parts.append(
+                        f"{prefix['swapins']} swap-ins "
+                        f"({prefix['swapin_blocks']} blocks, "
+                        f"{_fmt_s(prefix['swapin_seconds'])} total)"
+                    )
+                    tier_parts.append(
+                        "max swap-in stall "
+                        f"{_fmt_s(prefix['max_swapin_stall_seconds'])}"
+                    )
+                lines.append("prefix tiers: " + " · ".join(tier_parts))
             if prefix["prefill_chunks"]:
                 lines.append(
                     f"chunked prefill: {prefix['prefill_chunks']} chunks · "

@@ -25,7 +25,12 @@ from cloud_tpu.serving.engine import (
     SERVE_DISPATCH_THREAD_NAME,
     SERVE_SCHEDULER_THREAD_NAME,
 )
-from cloud_tpu.serving.prefix_cache import PrefixCacheManager, PrefixHit
+from cloud_tpu.serving.prefix_cache import (
+    AFFINITY_PREFIX_TOKENS,
+    PrefixCacheManager,
+    PrefixHit,
+    affinity_key,
+)
 from cloud_tpu.serving.qos import (
     BrownoutShedError,
     PriorityClass,
@@ -38,6 +43,8 @@ from cloud_tpu.serving.qos import (
 )
 
 __all__ = [
+    "AFFINITY_PREFIX_TOKENS",
+    "affinity_key",
     "BrownoutShedError",
     "DeadlineExceededError",
     "DispatchTimeoutError",

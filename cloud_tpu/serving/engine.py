@@ -31,7 +31,13 @@ with two schedulers sharing one submit/future/admission surface:
   slot row (``generation.copy_prefix_program``) and prefills only the
   uncached suffix, then saves the prompt's new full blocks back.
   Greedy outputs stay token-identical to a cold prefill — a hit moves
-  compute, never tokens.
+  compute, never tokens.  A **host-DRAM second tier**
+  (``prefix_dram_blocks > 0``) makes HBM eviction a demotion: the
+  block's bytes move to a bounded host-side pool and swap back in
+  asynchronously on a later hit (``serve/prefix_swapin``), with the
+  match-vs-acquire revalidation extended so a swap-in that loses the
+  race falls back to a cold prefill — docs/serving.md "Tiered prefix
+  cache".
 * **Chunked prefill** (``prefill_chunk_tokens``, continuous mode) —
   prompt prefill splits into bounded chunks
   (``generation.prefill_chunk_program``) the scheduler interleaves
@@ -246,6 +252,15 @@ class ServeConfig:
     #: Tokens per prefix block — the hit granularity (hits are whole
     #: blocks; a prompt's trailing partial block never caches).
     prefix_block_tokens: int = 16
+    #: Host-DRAM second tier for the prefix cache: blocks evicted from
+    #: the HBM pool demote to a bounded host-side pool of this many
+    #: blocks instead of vanishing, and a hit on a demoted prefix swaps
+    #: its blocks back in asynchronously (``serve/prefix_swapin``) —
+    #: hot system prompts survive HBM pressure.  0 (default) disables
+    #: the tier entirely (byte-identical to the single-tier cache;
+    #: the ``prefix_dram_*`` health/stats keys read zero).  Requires
+    #: ``prefix_cache_blocks > 0``.
+    prefix_dram_blocks: int = 0
     #: Chunked prefill (continuous mode): split prompt prefill into
     #: dispatches of this many tokens, interleaved with decode chunks,
     #: so a long arrival stalls in-flight decode by at most ONE chunk
@@ -349,6 +364,17 @@ class ServeConfig:
             raise ValueError(
                 f"prefix_block_tokens must be >= 1, got "
                 f"{self.prefix_block_tokens}"
+            )
+        if self.prefix_dram_blocks < 0:
+            raise ValueError(
+                f"prefix_dram_blocks must be >= 0, got "
+                f"{self.prefix_dram_blocks}"
+            )
+        if self.prefix_dram_blocks and not self.prefix_cache_blocks:
+            raise ValueError(
+                "prefix_dram_blocks (the host-DRAM tier) needs "
+                "prefix_cache_blocks > 0 — there is no HBM pool to "
+                "demote from or swap back into"
             )
         if (self.prefill_chunk_tokens is not None
                 and self.prefill_chunk_tokens < 1):
@@ -732,7 +758,12 @@ class ServingEngine:
                 from cloud_tpu.serving.prefix_cache import PrefixCacheManager
 
                 self._prefix = PrefixCacheManager(
-                    cfg.prefix_cache_blocks, cfg.prefix_block_tokens
+                    cfg.prefix_cache_blocks, cfg.prefix_block_tokens,
+                    dram_blocks=cfg.prefix_dram_blocks,
+                    demote_fn=(
+                        self._demote_block if cfg.prefix_dram_blocks
+                        else None
+                    ),
                 )
 
                 def make_pool():
@@ -758,6 +789,12 @@ class ServingEngine:
             self._finalize_traces = 0
             self._copy_traces = 0
             self._save_traces = 0
+            self._download_traces = 0
+            self._swapin_traces = 0
+            #: The DRAM-tier block movers (built on demand; one compile
+            #: each — block index and payload shapes are static).
+            self._download_step = None
+            self._swapin_step = None
             self._draft_traces = 0
             self._verify_traces = 0
             self._draft_prefill_traces = 0
@@ -1424,6 +1461,97 @@ class ServingEngine:
             self._save_cells[bucket_len] = cell
         return cell
 
+    def _download_cell(self):
+        """Pool-row download for the DRAM tier's demote path (ONE
+        compile — the block index is traced).  Reads only: the pool is
+        never donated through it."""
+        if self._download_step is None:
+            import jax
+
+            from cloud_tpu.models import generation
+            from cloud_tpu.training import compile_cache
+
+            def download_fn(pool, block):
+                self._download_traces += 1
+                return generation.download_prefix_block(pool, block)
+
+            self._download_step = compile_cache.AotStep(
+                jax.jit(download_fn), label="serve/prefix_download"
+            )
+        return self._download_step
+
+    def _swapin_cell(self):
+        """Pool-row upload for the DRAM tier's promote path (ONE
+        compile — block index traced, payload shapes static)."""
+        if self._swapin_step is None:
+            import jax
+
+            from cloud_tpu.models import generation
+            from cloud_tpu.training import compile_cache
+
+            def swapin_fn(pool, payload, block):
+                self._swapin_traces += 1
+                return generation.upload_prefix_block(pool, payload, block)
+
+            donate = (0,) if self._donate else ()
+            self._swapin_step = compile_cache.AotStep(
+                jax.jit(swapin_fn, donate_argnums=donate),
+                label="serve/prefix_swapin",
+            )
+        return self._swapin_step
+
+    def _demote_block(self, block: int):
+        """The manager's ``demote_fn``: capture one HBM pool row's bytes
+        host-side (numpy, outside jit) before the row is reused.  Runs
+        on the scheduler thread during allocation, strictly BEFORE the
+        save/swap-in dispatch that overwrites the row, so the bytes are
+        exactly what the trie says they are.  The download (and its
+        blocking device->host sync) runs under the watchdog like every
+        other dispatch: a wedged device fails typed instead of hanging
+        the scheduler on ``np.asarray`` forever."""
+        import jax
+
+        cell = self._download_cell()
+
+        def dispatch():
+            payload = cell(self._prefix_pool, np.int32(block))
+            return jax.tree_util.tree_map(np.asarray, payload)
+
+        with tracing.span("serve/prefix_demote", block=int(block)):
+            payload = self._supervised("serve/prefix_demote", dispatch)
+        metrics.counter_inc("serve/prefix_demotions")
+        return payload
+
+    def _dispatch_swapin(self, slot: int, plan) -> None:
+        """Upload a promotion plan's payloads into their fresh pool rows
+        (``serve/prefix_swapin`` span — the swap-in stall the report
+        attributes).  ``device_put`` is asynchronous: the host enqueues
+        the transfers and the subsequent copy dispatch waits on them in
+        dataflow order, off the scheduler's critical path."""
+        import jax
+
+        cell = self._swapin_cell()
+        tokens = len(plan) * self.serve_config.prefix_block_tokens
+
+        def dispatch():
+            # One watchdog budget for the WHOLE plan (a fully demoted
+            # long prefix can be dozens of blocks — one supervised
+            # thread, not one per block); still one executable, one
+            # upload dispatch per block.
+            pool = self._prefix_pool
+            for _node, block, payload in plan:
+                pool = cell(pool, jax.device_put(payload),
+                            np.int32(block))
+            return pool
+
+        with tracing.span("serve/prefix_swapin", slot=slot,
+                          blocks=len(plan), tokens=tokens):
+            self._prefix_pool = self._supervised(
+                "serve/prefix_swapin", dispatch
+            )
+        metrics.counter_inc("serve/prefix_swapins")
+        metrics.counter_inc("serve/prefix_swapin_blocks", len(plan))
+
     def _start_warmup(self) -> None:
         """Queue AOT compiles for the whole grid on the compile-ahead
         worker (one background thread, in grid order — smallest programs
@@ -1490,6 +1618,20 @@ class ServingEngine:
                     ), context))
                     jobs.append((self._save_cell(bucket_len), (
                         pool_avals, cache_avals, scalar, ids_aval,
+                    ), context))
+                if cfg.prefix_dram_blocks:
+                    # The tier's block movers: one executable each.
+                    payload_avals = {
+                        name: jax.ShapeDtypeStruct(
+                            (leaf.shape[0],) + leaf.shape[2:], leaf.dtype
+                        )
+                        for name, leaf in self._prefix_pool.items()
+                    }
+                    jobs.append((self._download_cell(), (
+                        pool_avals, scalar,
+                    ), context))
+                    jobs.append((self._swapin_cell(), (
+                        pool_avals, payload_avals, scalar,
                     ), context))
             if self._spec:
                 # Speculation replaces the decode chunk wholesale: warm
@@ -1978,18 +2120,33 @@ class ServingEngine:
         use_chunks = cfg.prefill_chunk_tokens is not None
         hit = None
         held: List[object] = []
+        swapin_plan = None
         if self._prefix is not None:
             with tracing.span("serve/prefix_lookup",
                               bucket=request.bucket_len, slot=slot) as span:
                 candidate = self._prefix.match(request.prompt.tolist())
                 faults.fault_point("serve.prefix_acquire")
-                if candidate and self._prefix.acquire(candidate):
-                    hit = candidate
-                    held.extend(candidate.nodes)
+                if candidate:
+                    if cfg.prefix_dram_blocks:
+                        # Tiered pin: promote any DRAM-demoted blocks
+                        # back into fresh HBM rows.  None = the swap-in
+                        # lost the race (blocks evicted since the match,
+                        # or HBM fully pinned): fall back to a cold
+                        # prefill — the PR 9 revalidation, extended.
+                        swapin_plan = self._prefix.acquire_swapin(
+                            candidate
+                        )
+                        if swapin_plan is not None:
+                            hit = candidate
+                            held.extend(candidate.nodes)
+                    elif self._prefix.acquire(candidate):
+                        hit = candidate
+                        held.extend(candidate.nodes)
                 span.set_attribute("hit", hit is not None)
                 span.set_attribute(
                     "hit_tokens", hit.tokens if hit is not None else 0
                 )
+                span.set_attribute("dram", bool(swapin_plan))
             if hit is not None:
                 metrics.counter_inc("serve/prefix_hits")
                 metrics.counter_inc("serve/prefix_hit_tokens", hit.tokens)
@@ -2012,6 +2169,10 @@ class ServingEngine:
         self._slot_table[slot] = _Slot(
             request=request, tokens=[], prefix_nodes=held
         )
+        if swapin_plan:
+            # The promoted rows must hold their bytes before the copy
+            # below reads them (dataflow-ordered on device).
+            self._dispatch_swapin(slot, swapin_plan)
         if hit is not None and hit.tokens:
             self._dispatch_copy(request, slot, hit)
         width = (
@@ -2654,9 +2815,13 @@ class ServingEngine:
         return backlog
 
     def _prefix_snapshot(self) -> dict:
-        """The three prefix-cache keys ``health()`` and ``stats()`` both
+        """The prefix-cache keys ``health()`` and ``stats()`` both
         carry (ONE spelling — the fleet router pins the schema): zeros
-        when the cache is off, so callers read a stable shape."""
+        when the cache is off, so callers read a stable shape.  The
+        ``prefix_dram_*`` keys are the host-DRAM tier's (zeros with
+        ``prefix_dram_blocks`` unset), and ``cached_prefixes`` is the
+        router-facing hot-prefix summary ({} when off) the cost-model
+        router scores candidates by."""
         prefix = (
             self._prefix.stats()
             if self._continuous and self._prefix is not None else None
@@ -2667,6 +2832,24 @@ class ServingEngine:
             ),
             "prefix_hit_tokens": prefix["hit_tokens"] if prefix else 0,
             "evictions": prefix["evictions"] if prefix else 0,
+            "prefix_dram_blocks": (
+                prefix["dram_blocks_in_use"] if prefix else 0
+            ),
+            "prefix_dram_hits": prefix["dram_hits"] if prefix else 0,
+            "prefix_dram_hit_tokens": (
+                prefix["dram_hit_tokens"] if prefix else 0
+            ),
+            "prefix_dram_demotions": prefix["demotions"] if prefix else 0,
+            "prefix_dram_evictions": (
+                prefix["dram_evictions"] if prefix else 0
+            ),
+            "prefix_dram_swapin_failures": (
+                prefix["swapin_failures"] if prefix else 0
+            ),
+            "cached_prefixes": (
+                self._prefix.hot_prefixes()
+                if self._continuous and self._prefix is not None else {}
+            ),
         }
 
     def stats(self) -> dict:

@@ -33,6 +33,17 @@ parity harness and ``check_chaos.py``'s degradation harness:
    streamed interactive request's tokens match its final result row,
    every completed request has token-for-token greedy parity, every
    interactive request completes, and zero threads leak.
+4. **flash crowd** (ISSUE 15) — N clients sharing ONE long system
+   prompt, interleaved 1:1 with unique background traffic that keeps
+   each replica's (deliberately small) tiered prefix cache under
+   eviction pressure, with the SAME mid-run replica kill in both arms:
+   a tie-break-only-affinity arm (the PR 9 router) and a cache-aware
+   cost-model arm (``cache_alpha``).  Asserted: cost-model crowd TTFT
+   p99 strictly below the tie-break arm's (concentrating the crowd on
+   the replica whose cache holds the prefix keeps it resident; load
+   spraying lets background churn flush it through both tiers), more
+   prefix hit tokens in the cost-model arm, token-for-token parity for
+   EVERY request in both arms, and zero leaked threads.
 
 Prints one JSON line per phase plus a summary::
 
@@ -516,6 +527,245 @@ def check_mixed_tenant_qos(timeout: float) -> dict:
     }
 
 
+def _flash_crowd_traffic(rng):
+    """One deterministic flash-crowd workload (shared by both routing
+    arms): a crowd of clients sharing ONE long system prompt (the
+    measured flash crowd), plus a second tenant's equally hot long
+    system prompt as the eviction pressure.  The two 30-block prefixes
+    together exceed one replica's HBM+DRAM tiers, so a replica can
+    stay warm for ONE of them but never both: cache-aware routing
+    partitions the tenants across the fleet (every request a cheap
+    hit), load-spraying interleaves them on both replicas and thrashes
+    both prefixes through both tiers on every alternation."""
+    import numpy as np
+
+    def tenant(n):
+        system_prompt = rng.integers(1, 255, 240).astype(np.int32)
+        return [
+            (np.concatenate(
+                [system_prompt,
+                 rng.integers(1, 255, 4).astype(np.int32)]
+            ), 3)
+            for _ in range(n)
+        ]
+
+    return tenant(26), tenant(26)
+
+
+def _run_flash_crowd_arm(params, config, *, cost_model: bool,
+                         timeout: float) -> dict:
+    """One arm of the flash-crowd comparison: the SAME crowd+pressure
+    traffic and the SAME mid-run replica kill through a 2-replica
+    tiered-prefix-cache fleet, routed either by the cache-aware cost
+    model (``cache_alpha``) or by the PR 9 tie-break-only affinity."""
+    import numpy as np
+
+    from cloud_tpu.fleet import Fleet, FleetConfig, LeastLoadedRouter
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils import faults
+
+    crowd, pressure = _flash_crowd_traffic(np.random.default_rng(11))
+    # Cache sizing is the experiment: ONE 30-block system prompt fits
+    # the 36-block HBM pool with room to breathe, but the OTHER
+    # tenant's 30-block insert evicts most of it and the 12-block DRAM
+    # tier cannot hold the demoted remainder — so a replica serving
+    # both tenants thrashes (partial swap-in hits, ~24 demotions and a
+    # long suffix prefill per alternation) while a replica serving one
+    # tenant hits for ~the whole prompt in ONE suffix chunk.
+    serve = ServeConfig(
+        max_new_tokens=4, prompt_buckets=(256,), batch_buckets=(1, 2),
+        num_slots=1, chunk_tokens=2,
+        prefix_cache_blocks=36, prefix_block_tokens=8,
+        prefix_dram_blocks=12,
+        prefill_chunk_tokens=16,
+        # SHORT watchdog: the kill's worst cost to any single request
+        # (~timeout + failover re-run) must stay well under the
+        # tie-break arm's thrash-driven TTFT floor, so the p99 gate
+        # measures routing, not kill luck (phase-3 discipline).
+        dispatch_timeout_s=0.15, warmup=True,
+    )
+
+    def factory():
+        return ServingEngine(params, config, serve, mesh=None)
+
+    # alpha sized so a whole burst sticks: a 240-token summary entry
+    # is worth 240 load units — more than any queue gap a burst can
+    # build — while requests with no summary entry anywhere still
+    # balance by load.
+    router = LeastLoadedRouter(
+        prefix_affinity=True,
+        cache_alpha=1.0 if cost_model else 0.0,
+    )
+    fleet = Fleet(
+        factory, FleetConfig(min_replicas=2, poll_interval_s=0.05),
+        router=router,
+    )
+    fleet.wait_ready(timeout=timeout)
+    # Warm pass outside the fault plan (phase-1 discipline).
+    fleet.submit(crowd[0][0][:4], max_new_tokens=2).result(timeout=timeout)
+
+    # SEED, fully drained before the measurement: crowd prefix onto
+    # replica 0 (cold-fleet ties break to the lowest id, then
+    # affinity), pressure prefix onto replica 1 (submitted while a
+    # crowd request is still in flight on 0, so least-loaded routing
+    # lands it on 1).  After this both arms' routers face the same
+    # state: summaries {0: crowd prefix, 1: pressure prefix}.
+    results = []
+
+    def serve_seed(request):
+        prompt, budget = request
+        results.append(
+            (prompt, budget,
+             fleet.submit(prompt, max_new_tokens=budget)
+             .result(timeout=timeout))
+        )
+
+    serve_seed(crowd[0])
+    serve_seed(crowd[1])
+    crowd_future = fleet.submit(crowd[2][0], max_new_tokens=crowd[2][1])
+    pressure_future = fleet.submit(pressure[0][0],
+                                   max_new_tokens=pressure[0][1])
+    results.append((crowd[2][0], crowd[2][1],
+                    crowd_future.result(timeout=timeout)))
+    results.append((pressure[0][0], pressure[0][1],
+                    pressure_future.result(timeout=timeout)))
+    serve_seed(pressure[1])
+
+    # The measured traffic: alternating same-tenant BURSTS, all
+    # submitted without waiting (open flood).  The cost model keeps
+    # each tenant on the replica whose summary advertises its prefix —
+    # the two replicas drain their tenants in parallel, every request
+    # a one-chunk hit.  The tie-break arm's affinity only fires on
+    # load-EQUAL ties, which a burst destroys immediately, so bursts
+    # spray by load, the tenants interleave on both replicas, and
+    # every alternation pays the thrash.  Mid-flood, a chunk dispatch
+    # hangs past the watchdog on whichever replica draws it — requests
+    # in flight there fail over, and the router re-learns the
+    # surviving cache from the LIVE cached_prefixes summaries.
+    plan = [{"site": "serve.chunk", "mode": "hang", "hang_s": 0.3,
+             "nth": 12}]
+    rounds = 5
+    per_burst = 4
+    outcomes = []
+    with faults.inject(plan) as active:
+        for r in range(rounds):
+            lo, hi = 3 + r * per_burst, 3 + (r + 1) * per_burst
+            for prompt, budget in crowd[lo:hi]:
+                outcomes.append(
+                    ("crowd", prompt, budget,
+                     fleet.submit(prompt, max_new_tokens=budget))
+                )
+            lo, hi = 2 + r * per_burst, 2 + (r + 1) * per_burst
+            for prompt, budget in pressure[lo:hi]:
+                outcomes.append(
+                    ("pressure", prompt, budget,
+                     fleet.submit(prompt, max_new_tokens=budget))
+                )
+        crowd_ttfts = []
+        for kind, prompt, budget, future in outcomes:
+            result = future.result(timeout=timeout)
+            results.append((prompt, budget, result))
+            if kind == "crowd":
+                crowd_ttfts.append(result.ttft_seconds)
+    # Let supervision converge (phase-1 discipline: the kill-close must
+    # first join the injected hang) before reading the final state.
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        stats = fleet.stats()
+        health = fleet.health()
+        if stats["restarts"] >= 1 and health["ready_replicas"] == 2:
+            break
+        time.sleep(0.05)
+    health = fleet.health()
+    stats = fleet.stats()
+    hit_tokens = sum(
+        int(h.get("prefix_hit_tokens") or 0) for h in health["replicas"]
+    )
+    dram_demotions = sum(
+        int(h.get("prefix_dram_demotions") or 0)
+        for h in health["replicas"]
+    )
+    fleet.close()
+    leaked = _fleet_threads()
+
+    mismatches = _parity_mismatches(
+        params, config,
+        [r[0] for r in results], [r[1] for r in results],
+        [r[2] for r in results],
+    )
+    return {
+        "cost_model": cost_model,
+        "crowd_ttfts": sorted(crowd_ttfts),
+        "completed": len(results),
+        "mismatches": mismatches,
+        "hit_tokens": hit_tokens,
+        "dram_demotions": dram_demotions,
+        "failovers": stats["failovers"],
+        "restarts": stats["restarts"],
+        "faults_fired": active.fired(),
+        "leaked_threads": leaked,
+    }
+
+
+def check_flash_crowd(timeout: float) -> dict:
+    """Phase 4 (ISSUE 15): cache-aware cost-model routing must beat the
+    tie-break-only affinity on crowd TTFT p99 under the SAME
+    shared-system-prompt flash crowd, background eviction pressure, and
+    mid-run replica kill — while every request keeps greedy parity and
+    nothing leaks."""
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import transformer
+
+    # A deeper TINY than the other phases': prefill compute must
+    # dominate the wave's drain time, so the TTFT gap the cache buys
+    # dwarfs the (symmetric) watchdog+failover cost of the kill.
+    config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=4)
+    params = transformer.init(jax.random.PRNGKey(2), config)
+    tiebreak = _run_flash_crowd_arm(params, config, cost_model=False,
+                                    timeout=timeout)
+    cost = _run_flash_crowd_arm(params, config, cost_model=True,
+                                timeout=timeout)
+    tiebreak_p99 = _p99(tiebreak["crowd_ttfts"])
+    cost_p99 = _p99(cost["crowd_ttfts"])
+    ok = (
+        cost_p99 < tiebreak_p99
+        and cost["hit_tokens"] > tiebreak["hit_tokens"]
+        and tiebreak["mismatches"] == 0
+        and cost["mismatches"] == 0
+        # The chaos must have HAPPENED: a fault that fired without
+        # killing and rebuilding a replica would green-light a
+        # kill-free run.
+        and tiebreak["restarts"] >= 1
+        and cost["restarts"] >= 1
+        and tiebreak["faults_fired"] == {"serve.chunk": 1}
+        and cost["faults_fired"] == {"serve.chunk": 1}
+        and not tiebreak["leaked_threads"]
+        and not cost["leaked_threads"]
+    )
+    return {
+        "phase": "flash_crowd",
+        "ok": ok,
+        "tiebreak_crowd_ttft_p99": round(tiebreak_p99, 4),
+        "cost_model_crowd_ttft_p99": round(cost_p99, 4),
+        "hit_tokens": {"tiebreak": tiebreak["hit_tokens"],
+                       "cost_model": cost["hit_tokens"]},
+        "dram_demotions": {"tiebreak": tiebreak["dram_demotions"],
+                           "cost_model": cost["dram_demotions"]},
+        "mismatches": tiebreak["mismatches"] + cost["mismatches"],
+        "failovers": {"tiebreak": tiebreak["failovers"],
+                      "cost_model": cost["failovers"]},
+        "restarts": {"tiebreak": tiebreak["restarts"],
+                     "cost_model": cost["restarts"]},
+        "faults_fired": {"tiebreak": tiebreak["faults_fired"],
+                         "cost_model": cost["faults_fired"]},
+        "leaked_threads": (
+            tiebreak["leaked_threads"] + cost["leaked_threads"]
+        ),
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--timeout", type=float, default=240.0,
@@ -527,6 +777,7 @@ def main(argv=None) -> int:
         check_churn_with_replica_kill(args.timeout),
         check_autoscale(args.timeout),
         check_mixed_tenant_qos(args.timeout),
+        check_flash_crowd(args.timeout),
     ]
     for phase in phases:
         print(json.dumps(phase), flush=True)
@@ -544,9 +795,14 @@ def main(argv=None) -> int:
         ),
         "quota_rejected": phases[2]["quota_rejected"],
         "brownout_shed": phases[2]["brownout_shed"],
+        "flash_crowd_ttft_win": (
+            phases[3]["cost_model_crowd_ttft_p99"]
+            < phases[3]["tiebreak_crowd_ttft_p99"]
+        ),
+        "flash_crowd_hit_tokens": phases[3]["hit_tokens"],
         "leaked_threads": (
             phases[0]["leaked_threads"] + phases[1]["leaked_threads"]
-            + phases[2]["leaked_threads"]
+            + phases[2]["leaked_threads"] + phases[3]["leaked_threads"]
         ),
         "wall_seconds": round(time.perf_counter() - start, 3),
     }), flush=True)

@@ -98,6 +98,11 @@ class FakeEngine:
         self.drained_close = None
         self.submits = []
         self.pending = []
+        #: Tiered-prefix-cache schema (ISSUE 15): mutable so tests can
+        #: simulate what a cache holds / loses across a failover.
+        self.cached_prefixes = {}
+        self.prefix_dram_blocks = 0
+        self.prefix_dram_demotions = 0
         self._lock = threading.Lock()
 
     def submit(self, prompt, *, max_new_tokens=None, deadline_s=None):
@@ -156,6 +161,9 @@ class FakeEngine:
             "slice_chips": 2,
             "orphaned_dispatches": 0,
             "last_dispatch_age_s": None,
+            "cached_prefixes": dict(self.cached_prefixes),
+            "prefix_dram_blocks": self.prefix_dram_blocks,
+            "prefix_dram_demotions": self.prefix_dram_demotions,
         }
 
     def close(self, drain=True, timeout=None):
@@ -326,6 +334,93 @@ class TestFleetRouting:
             with pytest.raises(ValueError, match="too long"):
                 future.result(timeout=10)
             assert spare.submits == []
+        finally:
+            fleet.close()
+
+
+class TestCacheAwareFleetRouting:
+    """ISSUE 15: the cost-model router composed with the fleet — live
+    ``cached_prefixes`` summaries steer requests, a stale affinity map
+    cannot override them after a failover, pre-affinity custom routers
+    keep working, and the supervisor exports the DRAM-tier gauges."""
+
+    def test_cost_model_follows_live_summary_not_stale_affinity(self):
+        from cloud_tpu.serving.prefix_cache import affinity_key
+
+        prompt = np.arange(1, 40, dtype=np.int32)
+        key = affinity_key(prompt)
+        first = FakeEngine("first")
+        second = FakeEngine("second")
+        first.cached_prefixes = {key: 64}
+        router = LeastLoadedRouter(prefix_affinity=True, cache_alpha=0.5)
+        fleet = Fleet(_Factory([first, second]), _quiet_config(
+            min_replicas=2
+        ), router=router)
+        try:
+            # Equal (zero) load: the summary credit decides, and the
+            # fleet records the affinity on replica 0 after success.
+            result = fleet.submit(prompt).result(timeout=10)
+            assert result["served_by"] == "first"
+            # The kill-and-rebuild story, distilled: replica 0's cache
+            # is gone (restart), the prefix now lives on replica 1 (it
+            # served the failover re-run).  The router reads the LIVE
+            # summaries, so the stale key -> replica-0 affinity entry
+            # must NOT keep attracting the crowd.
+            first.cached_prefixes = {}
+            second.cached_prefixes = {key: 64}
+            result = fleet.submit(prompt).result(timeout=10)
+            assert result["served_by"] == "second"
+        finally:
+            fleet.close()
+
+    def test_pre_affinity_two_arg_router_still_works(self):
+        """The ISSUE 15 satellite pin: a custom router with the
+        ORIGINAL two-argument ``pick(replicas, exclude=())`` signature
+        (no affinity_key, no priority, no record_affinity) routes a
+        fleet that now passes cache/affinity hints."""
+
+        class OldestRouter:
+            def pick(self, replicas, exclude=()):
+                excluded = set(exclude)
+                for replica in replicas:
+                    if replica.id in excluded and len(excluded) < len(
+                        list(replicas)
+                    ):
+                        continue
+                    health = replica.health()
+                    if replica.routable(health):
+                        return replica, health
+                return None, None
+
+        engine = FakeEngine("only")
+        fleet = Fleet(_Factory([engine]), _quiet_config(),
+                      router=OldestRouter())
+        try:
+            result = fleet.submit(
+                np.asarray([1, 2, 3], np.int32)
+            ).result(timeout=10)
+            assert result["served_by"] == "only"
+            assert fleet.stats()["completed"] == 1
+        finally:
+            fleet.close()
+
+    def test_supervisor_exports_prefix_dram_gauges(self):
+        from cloud_tpu.monitoring import metrics
+
+        first = FakeEngine("first")
+        second = FakeEngine("second")
+        first.prefix_dram_blocks = 5
+        first.prefix_dram_demotions = 7
+        second.prefix_dram_blocks = 3
+        second.prefix_dram_demotions = 2
+        fleet = Fleet(_Factory([first, second]), _quiet_config(
+            min_replicas=2
+        ))
+        try:
+            fleet._supervise_once()
+            gauges = metrics.snapshot()["gauges"]
+            assert gauges["fleet/prefix_dram_blocks"] == 8
+            assert gauges["fleet/prefix_dram_demotions"] == 9
         finally:
             fleet.close()
 

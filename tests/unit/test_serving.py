@@ -396,6 +396,15 @@ class TestHealth:
         for key in ("prefix_cache_blocks", "prefix_hit_tokens",
                     "evictions"):
             assert health[key] == 0, key
+        # ISSUE 15: the host-DRAM tier keys and the cost-model router's
+        # cached-prefix summary are schema too — zeros / empty whenever
+        # the tier (or the whole prefix cache) is off.
+        for key in ("prefix_dram_blocks", "prefix_dram_hits",
+                    "prefix_dram_hit_tokens", "prefix_dram_demotions",
+                    "prefix_dram_evictions",
+                    "prefix_dram_swapin_failures"):
+            assert health[key] == 0, key
+        assert health["cached_prefixes"] == {}
         # ISSUE 12: the speculative-decoding keys are schema too —
         # zeros whenever draft=None.
         assert health["spec_acceptance_rate"] == 0.0

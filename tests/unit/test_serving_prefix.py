@@ -1,4 +1,9 @@
-"""Prefix-cache KV reuse + chunked prefill (ISSUE 10 tentpole).
+"""Prefix-cache KV reuse + chunked prefill (ISSUE 10 tentpole), plus
+the ISSUE 15 fleet-wide KV economy: the host-DRAM second tier
+(demote/promote with ref-count-safe handoff, swap-in-loses-race cold
+fallback, byte-identical off path) and the cache-aware routing cost
+model (``load - alpha * expected_cached_prefix_tokens`` over the
+``cached_prefixes`` summaries ``health()`` exports).
 
 The load-bearing contract: greedy outputs stay token-identical to
 per-request ``generation.generate`` whether a prompt's prefix hit is
@@ -109,15 +114,176 @@ class TestPrefixCacheManager:
             PrefixCacheManager(num_blocks=0, block_tokens=4)
         with pytest.raises(ValueError, match="block_tokens"):
             PrefixCacheManager(num_blocks=4, block_tokens=0)
+        with pytest.raises(ValueError, match="dram_blocks"):
+            PrefixCacheManager(num_blocks=4, block_tokens=4,
+                               dram_blocks=-1)
         assert SKIP_BLOCK > 2 ** 20  # out of any real pool's range
 
 
+class TestPrefixTierManager:
+    """The host-DRAM second tier's bookkeeping (ISSUE 15): demote on
+    HBM eviction, promote on acquire, ref-count-safe handoff across
+    tiers, bounded DRAM with its own LRU leaf eviction — all host-only,
+    with a trivial fake ``demote_fn`` standing in for the engine's
+    device download."""
+
+    @staticmethod
+    def _tiered(num_blocks, dram_blocks, block_tokens=2):
+        demoted = []
+        manager = PrefixCacheManager(
+            num_blocks, block_tokens, dram_blocks=dram_blocks,
+            demote_fn=lambda block: demoted.append(block) or f"b{block}",
+        )
+        return manager, demoted
+
+    def test_demote_then_promote_refcount_safety(self):
+        m, demoted = self._tiered(2, 4)
+        held, _, _ = m.insert([1, 2, 3, 4, 9],
+                              PrefixHit(nodes=(), tokens=0))
+        m.release(held)
+        # A second tenant's insert reclaims both HBM rows: the first
+        # prefix DEMOTES instead of vanishing.
+        other, _, evicted = m.insert([7, 8, 9, 10, 11],
+                                     PrefixHit(nodes=(), tokens=0))
+        assert evicted == 2 and len(demoted) == 2
+        assert m.stats()["demotions"] == 2
+        hit = m.match([1, 2, 3, 4, 9])
+        assert hit.tokens == 4  # demoted nodes still match
+        # Promote back: allocation demotes the second tenant in turn
+        # (its blocks are unreferenced once released).
+        m.release(other)
+        plan = m.acquire_swapin(hit)
+        assert plan is not None and len(plan) == 2
+        assert [payload for _, _, payload in plan] == ["b0", "b1"] or all(
+            isinstance(p, str) for _, _, p in plan
+        )
+        assert all(n.tier == "hbm" and n.refs == 1 for n in hit.nodes)
+        stats = m.stats()
+        assert stats["promotions"] == 2 and stats["dram_hits"] == 1
+        assert stats["dram_hit_tokens"] == 4
+        # The promoted blocks are PINNED: nothing may reclaim them.
+        _, created, _ = m.insert([20, 21, 22, 23, 24],
+                                 PrefixHit(nodes=(), tokens=0))
+        assert created == []  # pool fully pinned: caches less
+        m.release(list(hit.nodes))
+
+    def test_pinned_block_never_demotes(self):
+        m, demoted = self._tiered(2, 4)
+        m.insert([1, 2, 3, 4, 9], PrefixHit(nodes=(), tokens=0))
+        hit = m.match([1, 2, 3, 4, 9])
+        assert m.acquire(hit)  # insert's ref + the pin on each block
+        # Allocation pressure cannot touch referenced blocks: no
+        # demotion, no eviction, the insert just caches less.
+        _, created, evicted = m.insert([7, 8, 9, 10, 11],
+                                       PrefixHit(nodes=(), tokens=0))
+        assert created == [] and evicted == 0 and demoted == []
+        assert all(n.tier == "hbm" for n in hit.nodes)
+        assert m.stats()["demotions"] == 0
+
+    def test_swapin_loses_race_falls_back_cold(self):
+        m, _ = self._tiered(2, 4)
+        held, _, _ = m.insert([1, 2, 3, 4, 9],
+                              PrefixHit(nodes=(), tokens=0))
+        m.release(held)
+        other, _, _ = m.insert([7, 8, 9, 10, 11],
+                               PrefixHit(nodes=(), tokens=0))
+        hit = m.match([1, 2, 3, 4, 9])
+        assert hit.tokens == 4
+        # ``other`` still pins the whole HBM pool: the promotion cannot
+        # allocate rows — the swap-in lost the race.  The acquire must
+        # fail WHOLE (no partial pins) and count a miss, exactly like
+        # the PR 9 evicted-between-match-and-acquire window.
+        assert m.acquire_swapin(hit) is None
+        assert all(n.refs == 0 for n in hit.nodes)
+        stats = m.stats()
+        assert stats["swapin_failures"] == 1
+        assert stats["acquire_failures"] == 1
+        assert stats["hits"] == 0 and stats["misses"] >= 1
+
+    def test_dram_lru_eviction_is_miss_after_demote_evict(self):
+        m, _ = self._tiered(1, 1, block_tokens=2)
+        for tokens in ([1, 2, 3], [4, 5, 6], [7, 8, 9]):
+            held, _, _ = m.insert(tokens, PrefixHit(nodes=(), tokens=0))
+            m.release(held)
+        stats = m.stats()
+        # [1,2] demoted, then dram-evicted to make room for [4,5],
+        # which was demoted by [7,8]'s insert.
+        assert stats["demotions"] == 2 and stats["dram_evictions"] == 1
+        assert not m.match([1, 2, 3])  # gone through BOTH tiers
+        assert m.match([4, 5, 6]).nodes[0].tier == "dram"
+
+    def test_plain_acquire_rejects_demoted_nodes(self):
+        """The single-tier pin must never hand out a DRAM node — its
+        bytes are not on the device."""
+        m, _ = self._tiered(1, 2)
+        held, _, _ = m.insert([1, 2, 3], PrefixHit(nodes=(), tokens=0))
+        m.release(held)
+        m.insert([4, 5, 6], PrefixHit(nodes=(), tokens=0))
+        hit = m.match([1, 2, 3])
+        assert hit.nodes[0].tier == "dram"
+        assert not m.acquire(hit)
+        assert m.stats()["acquire_failures"] == 1
+
+    def test_demote_without_fn_vanishes_like_pr9(self):
+        m = PrefixCacheManager(1, 2, dram_blocks=4)  # no demote_fn
+        held, _, _ = m.insert([1, 2, 3], PrefixHit(nodes=(), tokens=0))
+        m.release(held)
+        m.insert([4, 5, 6], PrefixHit(nodes=(), tokens=0))
+        assert not m.match([1, 2, 3])
+        assert m.stats()["demotions"] == 0
+        assert m.stats()["evictions"] == 1
+
+    def test_hot_prefixes_summary_matches_request_keys(self):
+        from cloud_tpu.serving.prefix_cache import (
+            AFFINITY_PREFIX_TOKENS,
+            affinity_key,
+        )
+
+        m = PrefixCacheManager(16, 4)
+        head = list(range(100, 140))  # 40 tokens > the 32-token key
+        held, _, _ = m.insert(head + [1], PrefixHit(nodes=(), tokens=0))
+        m.release(held)
+        summary = m.hot_prefixes()
+        # A request sharing the head produces the SAME key the summary
+        # carries — the router's lookup path.
+        key = affinity_key(head + [7, 8, 9])
+        assert summary[key] == 40
+        assert key == affinity_key(head[:AFFINITY_PREFIX_TOKENS])
+        # The summary is a snapshot: mutating the returned dict does
+        # not corrupt the manager's own copy.
+        summary[key] = 0
+        assert m.hot_prefixes()[key] == 40
+        # The steady hot path (hit -> release, re-walk insert with no
+        # new blocks) never pays the summary DFS: the trie's node set
+        # did not change, so the version gate skips the rebuild.
+        version = m._summary_version
+        hot = m.match(head + [5])
+        assert m.acquire(hot)
+        m.release(list(hot.nodes))
+        m.insert(head + [5], hot)
+        assert m._summary_version == version
+        assert m._shape_version == version
+        # A cached prefix SHORTER than the key length emits nothing: no
+        # request's affinity key can ever hash a d-token path (the
+        # cacheable span caps at len-1, so hitters hash >= d+1 tokens)
+        # and dead keys must not crowd the bounded summary.
+        short_held, _, _ = m.insert([7, 8, 9, 10, 11],
+                                    PrefixHit(nodes=(), tokens=0))
+        m.release(short_held)
+        assert list(m.hot_prefixes()) == [key]
+        # Eviction shrinks the advertised depth.
+        held, _, _ = m.insert(head + [1], PrefixHit(nodes=(), tokens=0))
+        m.release(held)
+        m.evict_prefix(head + [1])
+        assert m.hot_prefixes() == {}
+
+
 class _FakeReplica:
-    def __init__(self, rid, load, ready=True):
+    def __init__(self, rid, load, ready=True, cached=None):
         self.id = rid
         self._health = {
             "ready": ready, "queue_depth": load, "active_slots": 0,
-            "num_slots": 4,
+            "num_slots": 4, "cached_prefixes": dict(cached or {}),
         }
 
     def health(self):
@@ -176,6 +342,93 @@ class TestRouterPrefixAffinity:
         assert picked.id == 1
 
 
+class TestRouterCostModel:
+    """ISSUE 15 (b): ``score = load - cache_alpha * expected cached
+    prefix tokens`` over the live ``cached_prefixes`` summaries —
+    a real cost model, not a tie-break."""
+
+    def test_cached_replica_wins_despite_load(self):
+        from cloud_tpu.fleet.router import LeastLoadedRouter
+
+        router = LeastLoadedRouter(cache_alpha=0.1)
+        busy_cached = _FakeReplica(0, 2, cached={42: 64})
+        idle_cold = _FakeReplica(1, 0)
+        picked, _ = router.pick([busy_cached, idle_cold], affinity_key=42)
+        assert picked.id == 0  # 2 - 6.4 beats 0
+        # A key the summary does not carry gets no credit.
+        picked, _ = router.pick([busy_cached, idle_cold], affinity_key=9)
+        assert picked.id == 1
+        # No key at all: plain load.
+        picked, _ = router.pick([busy_cached, idle_cold])
+        assert picked.id == 1
+        # alpha calibrates: too-small credit and load wins again.
+        weak = LeastLoadedRouter(cache_alpha=0.01)
+        picked, _ = weak.pick([busy_cached, idle_cold], affinity_key=42)
+        assert picked.id == 1
+
+    def test_alpha_zero_is_tie_break_only(self):
+        """The PR 9 contract survives byte-identical: without
+        ``cache_alpha`` the summary is ignored and affinity only picks
+        among load-equal candidates."""
+        from cloud_tpu.fleet.router import LeastLoadedRouter
+
+        router = LeastLoadedRouter(prefix_affinity=True)
+        a = _FakeReplica(0, 1, cached={42: 64})
+        b = _FakeReplica(1, 1)
+        router.record_affinity(42, 1)
+        picked, _ = router.pick([a, b], affinity_key=42)
+        assert picked.id == 1  # tie-break follows the map, not the cache
+        busy, idle = _FakeReplica(0, 5, cached={42: 64}), _FakeReplica(1, 0)
+        picked, _ = router.pick([busy, idle], affinity_key=42)
+        assert picked.id == 1  # and load still always wins
+
+    def test_stale_affinity_map_loses_to_live_summary(self):
+        """The ISSUE 15 failover satellite: after a replica restart the
+        record_affinity map can point at a replica whose cache is gone.
+        The cost model reads the LIVE summary, so the replica that
+        actually holds the prefix (the failover target) wins — and the
+        stale map, being a tie-break only, cannot override it."""
+        from cloud_tpu.fleet.router import LeastLoadedRouter
+
+        router = LeastLoadedRouter(prefix_affinity=True, cache_alpha=0.1)
+        warm = _FakeReplica(0, 1, cached={42: 48})
+        restarted = _FakeReplica(1, 1)  # empty cache after rebuild
+        router.record_affinity(42, 1)  # stale: recorded before the kill
+        picked, _ = router.pick([warm, restarted], affinity_key=42)
+        assert picked.id == 0
+
+    def test_composes_with_class_weights(self):
+        from cloud_tpu.fleet.router import LeastLoadedRouter
+
+        router = LeastLoadedRouter(
+            class_weights={"interactive": 8.0, "batch": 1.0},
+            cache_alpha=0.1,
+        )
+        # 8 batch requests discount to 1 for an interactive arrival;
+        # the 40-token cache credit then pulls the score below the
+        # idle candidate's 0.
+        loaded = _FakeReplica(0, 8, cached={42: 40})
+        loaded._health["class_backlog"] = {"interactive": 0, "batch": 8}
+        idle = _FakeReplica(1, 0)
+        idle._health["class_backlog"] = {"interactive": 0, "batch": 0}
+        picked, _ = router.pick([loaded, idle], affinity_key=42,
+                                priority="interactive")
+        assert picked.id == 0
+        # Without the cache credit the discounted load (1) still loses.
+        tie_only = LeastLoadedRouter(
+            class_weights={"interactive": 8.0, "batch": 1.0}
+        )
+        picked, _ = tie_only.pick([loaded, idle], affinity_key=42,
+                                  priority="interactive")
+        assert picked.id == 1
+
+    def test_validation(self):
+        from cloud_tpu.fleet.router import LeastLoadedRouter
+
+        with pytest.raises(ValueError, match="cache_alpha"):
+            LeastLoadedRouter(cache_alpha=-0.5)
+
+
 class TestReportPrefixSection:
     def _event(self, name, ts, dur, **args):
         return {"name": name, "ph": "X", "ts": ts, "dur": dur,
@@ -203,6 +456,46 @@ class TestReportPrefixSection:
         assert "prefix cache:" in rendered
         assert "chunked prefill:" in rendered
         assert "max decode stall" in rendered
+
+    def test_tier_split_and_swapin_attribution(self):
+        """ISSUE 15: lookup spans stamped ``dram=True`` split the hit
+        count by tier, and ``serve/prefix_swapin`` spans attribute the
+        swap-in stall (max = worst single admission)."""
+        from cloud_tpu.monitoring.report import TraceReport
+
+        events = [
+            self._event("serve/prefix_lookup", 0, 10, hit=True,
+                        hit_tokens=32, dram=False),
+            self._event("serve/prefix_lookup", 20, 10, hit=True,
+                        hit_tokens=16, dram=True),
+            self._event("serve/prefix_lookup", 40, 10, hit=False,
+                        hit_tokens=0),
+            self._event("serve/prefix_swapin", 25, 4000, blocks=4,
+                        tokens=16),
+            self._event("serve/prefix_swapin", 60, 2000, blocks=2,
+                        tokens=8),
+        ]
+        report = TraceReport(events)
+        summary = report.prefix_summary()
+        assert summary["hbm_hits"] == 1 and summary["dram_hits"] == 1
+        assert summary["swapins"] == 2
+        assert summary["swapin_blocks"] == 6
+        assert summary["max_swapin_stall_seconds"] == pytest.approx(
+            0.004
+        )
+        rendered = report.render()
+        assert "prefix tiers:" in rendered
+        assert "max swap-in stall" in rendered
+        # Tier-off timelines (PR 9 span shapes) carry zeros and render
+        # WITHOUT the tier line.
+        old = TraceReport([
+            self._event("serve/prefix_lookup", 0, 10, hit=True,
+                        hit_tokens=8),
+        ])
+        old_summary = old.prefix_summary()
+        assert old_summary["dram_hits"] == 0
+        assert old_summary["swapins"] == 0
+        assert "prefix tiers:" not in old.render()
 
     def test_empty_timeline_no_crash(self):
         """The ISSUE satellite pin, same contract as the fleet section:
@@ -233,9 +526,16 @@ class TestServeConfigKnobs:
             ServeConfig(scheduler="batch", prefix_cache_blocks=4)
         with pytest.raises(ValueError, match="continuous"):
             ServeConfig(scheduler="batch", prefill_chunk_tokens=8)
-        # Compatibility default: both knobs off.
+        # ISSUE 15: the DRAM tier needs a non-negative bound AND an HBM
+        # pool to demote from.
+        with pytest.raises(ValueError, match="prefix_dram_blocks"):
+            ServeConfig(prefix_cache_blocks=4, prefix_dram_blocks=-1)
+        with pytest.raises(ValueError, match="prefix_dram_blocks"):
+            ServeConfig(prefix_dram_blocks=8)
+        # Compatibility default: every knob off.
         cfg = ServeConfig()
         assert cfg.prefix_cache_blocks == 0
+        assert cfg.prefix_dram_blocks == 0
         assert cfg.prefill_chunk_tokens is None
 
 
@@ -550,6 +850,177 @@ class TestChunkedPrefill:
         assert stats["prefill_chunks"] > 0
         assert engine.chunk_traces == 1
         assert engine._prefill_chunk_traces == 1
+
+
+class TestPrefixTierEngine:
+    """ISSUE 15 engine-level contracts: the host-DRAM tier's demote ->
+    swap-in path keeps greedy outputs token-identical to cold
+    ``generate()``, a swap-in that loses the race falls back cold, and
+    the off path is inert with a zeroed schema."""
+
+    def test_block_download_upload_roundtrip(self, model):
+        """The tier's serialization contract: a downloaded block's host
+        payload uploaded into ANY pool row reproduces the source row's
+        bytes exactly, for every cache leaf (k/v — and, because the
+        leaf loop is generic, the int8+scale leaves of a quantized
+        pool ride the same path, pinned end-to-end by the slow
+        kv_quant churn test)."""
+        import jax.numpy as jnp
+
+        from cloud_tpu.models import generation
+
+        config, _ = model
+        pool = generation.init_prefix_pool(config, 4, 4)
+        pool = {
+            name: leaf + jnp.arange(leaf.size, dtype=leaf.dtype).reshape(
+                leaf.shape
+            )
+            for name, leaf in pool.items()
+        }
+        payload = generation.download_prefix_block(pool, 2)
+        restored = generation.upload_prefix_block(pool, {
+            name: np.asarray(leaf) for name, leaf in payload.items()
+        }, 0)
+        for name, leaf in restored.items():
+            np.testing.assert_array_equal(
+                np.asarray(leaf[:, 0]), np.asarray(pool[name][:, 2])
+            )
+            # Other rows untouched.
+            np.testing.assert_array_equal(
+                np.asarray(leaf[:, 1:]), np.asarray(pool[name][:, 1:])
+            )
+
+    def test_dram_off_is_inert_and_schema_zero(self, model):
+        from cloud_tpu.serving import ServeConfig, ServingEngine
+
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(16,), batch_buckets=(1,),
+            num_slots=1, chunk_tokens=2,
+            prefix_cache_blocks=4, prefix_block_tokens=4,
+        )
+        engine = ServingEngine(params, config, serve, start=False)
+        try:
+            # No DRAM pool machinery exists: the manager is single-tier
+            # (no demote hook), no mover programs were built, and the
+            # schema keys read zero.
+            assert engine._prefix.dram_blocks == 0
+            assert engine._prefix.demote_fn is None
+            assert engine._download_step is None
+            assert engine._swapin_step is None
+            health = engine.health()
+            for key in ("prefix_dram_blocks", "prefix_dram_hits",
+                        "prefix_dram_hit_tokens", "prefix_dram_demotions",
+                        "prefix_dram_evictions",
+                        "prefix_dram_swapin_failures"):
+                assert health[key] == 0, key
+            assert health["cached_prefixes"] == {}
+        finally:
+            engine.close(drain=False)
+
+    def test_demote_swapin_hit_parity_and_lost_race_fallback(self, model):
+        """The tier states in one engine run: cold fill -> eviction
+        pressure demotes the head to DRAM -> a repeat prompt hits via
+        swap-in (token-identical) -> a forced lost-race acquire falls
+        back to a cold prefill (still token-identical)."""
+        from cloud_tpu.serving import ServeConfig, ServingEngine
+
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=4, prompt_buckets=(16,), batch_buckets=(1,),
+            num_slots=1, chunk_tokens=2,
+            prefix_cache_blocks=3, prefix_block_tokens=4,
+            prefix_dram_blocks=8,
+        )
+        rng = np.random.default_rng(31)
+        head = rng.integers(1, 255, 9).astype(np.int32)
+        other = rng.integers(1, 255, 13).astype(np.int32)
+        prompts = [
+            np.concatenate([head, rng.integers(1, 255, 3).astype(np.int32)]),
+            other,  # its 3-block insert demotes the head's 2 blocks
+            np.concatenate([head, rng.integers(1, 255, 4).astype(np.int32)]),
+            np.concatenate([head, rng.integers(1, 255, 2).astype(np.int32)]),
+        ]
+        with ServingEngine(params, config, serve) as engine:
+            results = [
+                engine.submit(p).result(timeout=120) for p in prompts[:3]
+            ]
+            stats_mid = engine.stats()
+            # The lost race: every tiered acquire fails once the match
+            # succeeded (exactly what a fully pinned pool looks like
+            # to the scheduler) — the engine must serve cold.
+            real = engine._prefix.acquire_swapin
+            engine._prefix.acquire_swapin = lambda hit: None
+            try:
+                results.append(
+                    engine.submit(prompts[3]).result(timeout=120)
+                )
+            finally:
+                engine._prefix.acquire_swapin = real
+            stats = engine.stats()
+            health = engine.health()
+        _assert_parity(params, config, prompts, results)
+        assert stats_mid["prefix_dram_demotions"] >= 2
+        assert stats_mid["prefix_dram_hits"] >= 1
+        assert stats_mid["prefix_dram_hit_tokens"] >= 8
+        assert stats["prefix_misses"] > stats_mid["prefix_misses"]
+        # One compile each for the tier's block movers.
+        assert engine._download_traces == 1
+        assert engine._swapin_traces == 1
+        assert engine.chunk_traces == 1
+        # The summary the cost-model router reads is live and keyed by
+        # the shared head's leading tokens.
+        assert isinstance(health["cached_prefixes"], dict)
+        assert health["prefix_dram_blocks"] >= 0
+
+    @pytest.mark.slow
+    def test_tier_churn_parity_with_kv_quant(self, model):
+        """Staggered churn through tiny two-tier pools with kv_quant
+        int8: demotions, swap-ins, AND misses-after-demote-evict all
+        occur, and every output stays token-identical to cold
+        generate() (the ISSUE 15 acceptance matrix's quantized arm —
+        the tier moves int8 blocks plus their scale leaves)."""
+        from cloud_tpu.serving import ServeConfig, ServingEngine
+
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=4, prompt_buckets=(16,), batch_buckets=(1, 2),
+            num_slots=2, chunk_tokens=2,
+            prefix_cache_blocks=3, prefix_block_tokens=4,
+            prefix_dram_blocks=3,  # small enough to dram-evict too
+            kv_quant=True,
+        )
+        rng = np.random.default_rng(33)
+        heads = [rng.integers(1, 255, 9).astype(np.int32)
+                 for _ in range(3)]
+        prompts = []
+        for i in range(9):
+            prompts.append(np.concatenate([
+                heads[i % 3],
+                rng.integers(1, 255, int(rng.integers(2, 6))).astype(
+                    np.int32
+                ),
+            ]))
+        budgets = [int(rng.integers(2, 5)) for _ in prompts]
+        engine = ServingEngine(params, config, serve)
+        futures = []
+        for i, prompt in enumerate(prompts):
+            futures.append(
+                engine.submit(prompt, max_new_tokens=budgets[i])
+            )
+            if i in (2, 5):
+                time.sleep(0.05)
+        results = [f.result(timeout=120) for f in futures]
+        stats = engine.stats()
+        engine.close()
+        _assert_parity(params, config, prompts, results, budgets)
+        # Three 2-block heads cycling through a 3-block HBM pool and a
+        # 3-block DRAM pool: demotions and dram evictions both happen.
+        assert stats["prefix_dram_demotions"] > 0
+        assert stats["prefix_dram_evictions"] > 0
+        assert stats["completed"] == len(prompts)
+        assert engine._swapin_traces <= 1
+        assert engine._download_traces <= 1
 
 
 class TestShardedPrefix:
