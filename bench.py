@@ -1302,10 +1302,58 @@ def _measure_fleet(extras):
     extras["fleet_p50_latency_seconds"] = round(_latency_pct(latencies, 0.5), 4)
     extras["fleet_p99_latency_seconds"] = round(_latency_pct(latencies, 0.99), 4)
     extras["fleet_failover_count"] = stats["failovers"]
+    _emit_ttft_decomposition(extras, "fleet", results)
     extras["fleet_config"] = (
         f"SMALL replicas{FLEET_REPLICAS} slots{SERVE_MAX_BATCH} "
         f"chunk{SERVE_CHURN_CHUNK} new<= {SERVE_NEW_TOKENS} "
         f"n{SERVE_CHURN_REQUESTS} staggered"
+    )
+
+
+def _emit_ttft_decomposition(extras, key, results, *, gate=False):
+    """Trace-derived TTFT attribution for a fleet probe's requests.
+
+    The bench child runs with tracing enabled, so every fleet
+    submission carried a trace context; stitching THIS probe's trace
+    ids (from ``ServeResult.trace_id``) out of the live ring buffer
+    yields the queue / route / swap-in / prefill / first-decode shares
+    of fleet TTFT at p99 — the distributional view a raw percentile
+    hides (a regression that moves time between phases at equal TTFT
+    still shows here).  With ``gate=True`` an incomplete lifecycle
+    (a traced request missing its ``fleet/route`` or terminal
+    ``serve/request`` span) raises, failing the phase: the probe
+    promises every request stitches end to end.
+    """
+    from cloud_tpu.monitoring import tracing
+    from cloud_tpu.monitoring.report import TraceReport
+
+    trace_ids = {r.trace_id for r in results if r.trace_id}
+    if not trace_ids:
+        return
+    report = TraceReport(tracing.timeline_events())
+    summary = report.request_summary() or {}
+    mine = {t: summary[t] for t in trace_ids if t in summary}
+    if gate:
+        incomplete = sorted(
+            t for t in trace_ids
+            if not mine.get(t, {}).get("complete")
+            or not mine.get(t, {}).get("routes")
+        )
+        if incomplete:
+            raise RuntimeError(
+                f"{key}: {len(incomplete)}/{len(trace_ids)} traced "
+                "requests did not stitch a complete lifecycle "
+                f"(first: {incomplete[0]})"
+            )
+    decomposition = report.ttft_decomposition(mine)
+    if not decomposition:
+        return
+    for name in TraceReport.TTFT_COMPONENTS:
+        extras[f"{key}_ttft_{name}_share_p99"] = round(
+            decomposition["shares"][name]["p99"], 4
+        )
+    extras[f"{key}_ttft_traced_p99_seconds"] = round(
+        decomposition["ttft_p99_s"], 4
     )
 
 
@@ -1344,6 +1392,7 @@ def _measure_fleet_qps_sweep(extras):
         return ServingEngine(params, cfg, serve, mesh=None)
 
     rng = np.random.default_rng(3)
+    sweep_results = []
     with Fleet(factory, FleetConfig(
         min_replicas=FLEET_REPLICAS, max_replicas=FLEET_REPLICAS,
         poll_interval_s=0.1, qos=QosConfig(),
@@ -1412,6 +1461,14 @@ def _measure_fleet_qps_sweep(extras):
                 extras[f"{key}_{name}_ttft_p99_seconds"] = round(
                     _latency_pct(class_ttfts, 0.99), 4
                 )
+            sweep_results.extend(results)
+    # Trace-completeness gate over the WHOLE sweep: every traced
+    # request must stitch a full routed lifecycle, and the shares of
+    # the sweep's fleet TTFT ride the artifact next to the raw
+    # percentiles above.
+    _emit_ttft_decomposition(
+        extras, "fleet_sweep", sweep_results, gate=True
+    )
     extras["fleet_sweep_config"] = (
         f"SMALL replicas{FLEET_REPLICAS} open-loop "
         f"qps{list(FLEET_SWEEP_QPS)} n{FLEET_SWEEP_REQUESTS}/point "

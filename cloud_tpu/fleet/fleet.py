@@ -51,6 +51,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import logging
+import os
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
@@ -232,12 +233,29 @@ class _FleetRequest:
     #: popped again but must not charge its class a second time for
     #: service it never received.
     charged: bool = False
+    #: Trace context minted at submit while tracing is enabled (None
+    #: otherwise — inert).  Lives on the REQUEST, not the attempt: a
+    #: failover re-admission carries the same identity, which is what
+    #: lets report.py stitch a request's hops across replicas.
+    trace: Optional[tracing.TraceContext] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
 
     def remaining(self, now: float) -> Optional[float]:
         return None if self.deadline is None else self.deadline - now
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.trace_id if self.trace is not None else None
+
+
+def _trace_attrs(request: "_FleetRequest", **attrs) -> dict:
+    """Span attributes + the request's ``trace_id`` when it carries a
+    trace context (untraced requests keep the exact attrs passed in)."""
+    if request.trace is not None:
+        attrs["trace_id"] = request.trace.trace_id
+    return attrs
 
 
 class Fleet:
@@ -320,6 +338,9 @@ class Fleet:
             "scale_ups": 0, "scale_downs": 0,
             # QoS counters (0 unless FleetConfig.qos arms them).
             "quota_rejected": 0, "brownout_shed": 0,
+            # Requests submitted carrying a TraceContext (0 with
+            # tracing off — stable schema either way).
+            "traced": 0,
         }
         self._routed: Dict[int, int] = {}
 
@@ -530,8 +551,10 @@ class Fleet:
             ),
             affinity_key=prefix_cache.affinity_key(prompt),
             priority=priority, tenant=tenant, stream=token_stream,
+            trace=tracing.new_trace_context(),
         )
         if token_stream is not None:
+            token_stream.trace_id = request.trace_id
             # Every fleet resolution path goes through the future; the
             # callback closes the stream with the re-based result (or
             # the typed failure) and back-fills undelivered tokens.
@@ -571,6 +594,8 @@ class Fleet:
             raise
         with self._stats_lock:
             self._stats["submitted"] += 1
+            if request.trace is not None:
+                self._stats["traced"] += 1
         metrics.counter_inc("fleet/requests")
         return token_stream if token_stream is not None else request.future
 
@@ -672,7 +697,8 @@ class Fleet:
             shed += 1
             tracing.record_span(
                 "fleet/shed", request.submitted, now,
-                reason="brownout", priority=request.priority,
+                **_trace_attrs(request, reason="brownout",
+                               priority=request.priority),
             )
             self._resolve(request, exc=BrownoutShedError(
                 f"request shed under brownout: fleet queue exceeded "
@@ -702,7 +728,8 @@ class Fleet:
                 continue
             shed += 1
             tracing.record_span(
-                "fleet/shed", request.submitted, now, reason="deadline",
+                "fleet/shed", request.submitted, now,
+                **_trace_attrs(request, reason="deadline"),
             )
             self._resolve(request, exc=DeadlineExceededError(
                 f"request shed at the fleet after waiting "
@@ -727,7 +754,8 @@ class Fleet:
             if request.expired(now):
                 # Permanent by classification: shed, never submitted.
                 tracing.record_span(
-                    "fleet/shed", request.submitted, now, reason="deadline",
+                    "fleet/shed", request.submitted, now,
+                    **_trace_attrs(request, reason="deadline"),
                 )
                 metrics.counter_inc("fleet/shed")
                 raise DeadlineExceededError(
@@ -768,6 +796,13 @@ class Fleet:
                 extra["priority"] = request.priority
             if request.stream is not None:
                 extra["on_token"] = request.stream.feed
+            if request.trace is not None and replica.accepts_trace:
+                # The trace context hops with the request — same object
+                # on every failover re-submit — but only to engines
+                # whose submit() takes it (the replica probes the
+                # signature at start(), same idiom as the router-pick
+                # probes above).
+                extra["trace"] = request.trace
             try:
                 inner = replica.engine.submit(
                     request.prompt,
@@ -811,6 +846,21 @@ class Fleet:
         occupancy = Replica.occupancy_of(health)
         if occupancy is not None:
             span_attrs["occupancy"] = round(occupancy, 4)
+        if request.trace is not None:
+            span_attrs["trace_id"] = request.trace.trace_id
+            if request.attempts == 1:
+                # Pure fleet queue wait (submit -> this route pass) —
+                # only meaningful on the FIRST accepted attempt; a
+                # re-route's gap includes the failed service time.
+                # report.py's TTFT decomposition reads it.
+                span_attrs["queue_s"] = round(
+                    route_start - request.submitted, 6
+                )
+            cached = getattr(self._router, "last_pick_cached_tokens", 0)
+            if cached:
+                # Cache-aware routing credit that won this pick — lets
+                # the TTFT drill-down show WHY a replica was chosen.
+                span_attrs["cached_tokens"] = int(cached)
         tracing.record_span("fleet/route", route_start, now, **span_attrs)
         metrics.counter_inc("fleet/routed")
         with self._stats_lock:
@@ -825,8 +875,10 @@ class Fleet:
                          exc: BaseException) -> None:
         now = time.perf_counter()
         tracing.record_span(
-            "fleet/failover", now, now, replica=replica.id,
-            error=type(exc).__name__, attempt=request.attempts,
+            "fleet/failover", now, now,
+            **_trace_attrs(request, replica=replica.id,
+                           error=type(exc).__name__,
+                           attempt=request.attempts),
         )
         metrics.counter_inc("fleet/failovers")
         with self._stats_lock:
@@ -882,6 +934,9 @@ class Fleet:
                         ),
                         0.0,
                     ),
+                    # Backfill for engines whose submit() predates the
+                    # trace kwarg: the fleet still owns the identity.
+                    trace_id=result.trace_id or request.trace_id,
                 )
             self._resolve(request, result=result)
             return
@@ -1211,3 +1266,55 @@ class Fleet:
             snap["class_shed"] = dict(self._class_shed)
         snap["replicas"] = self.num_replicas()
         return snap
+
+    def dump_timeline(self, path: str) -> str:
+        """Write ONE merged Chrome-trace JSON for the whole fleet.
+
+        Every replica's spans land in their own labelled ``pid`` lane
+        (the lane its engine's scheduler adopted at ``Replica.start``)
+        and the fleet's own spans — routing, failover, shed — plus any
+        events no replica lane claimed (engine construction, warmup
+        compiles) land in the ``fleet`` lane, so a single Perfetto view
+        shows a request bouncing between replicas.  Today all lanes
+        share one in-process collector, so their epochs coincide; the
+        merge still goes through :func:`tracing.merge_timelines`'s
+        monotonic-offset normalization so per-process collectors
+        (disaggregated prefill/decode, multi-host pods) drop in without
+        changing this file format.  Empty-but-valid JSON when tracing
+        is off.
+        """
+        collector = tracing.active()
+        snap = collector.snapshot() if collector is not None else {
+            "epoch": 0.0, "events": [], "evicted": 0,
+        }
+        lanes = []  # (lane pid, label), fleet's default lane first
+        with self._cond:
+            replicas = list(self._replicas)
+        for replica in replicas:
+            lane = getattr(replica, "trace_lane", None)
+            if lane is not None:
+                lanes.append((lane, f"replica {replica.id}"))
+        by_lane: Dict[int, List[dict]] = {lane: [] for lane, _ in lanes}
+        fleet_events: List[dict] = []
+        for event in snap["events"]:
+            bucket = by_lane.get(event.get("pid"))
+            (bucket if bucket is not None else fleet_events).append(event)
+        sources = [{
+            "label": "fleet",
+            "epoch": snap["epoch"],
+            "events": fleet_events,
+            # The ring buffer is shared: account its evictions once,
+            # on the fleet source.
+            "evicted": snap["evicted"],
+            "pid": os.getpid(),
+        }]
+        sources += [
+            {
+                "label": label,
+                "epoch": snap["epoch"],
+                "events": by_lane[lane],
+                "pid": lane,
+            }
+            for lane, label in lanes
+        ]
+        return tracing.merge_timelines(sources, path)

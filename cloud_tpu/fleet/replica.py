@@ -27,14 +27,32 @@ the chaos harness can make replica creation fail deterministically.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import threading
 import time
 from typing import Callable, Optional
 
+from cloud_tpu.monitoring import tracing
 from cloud_tpu.utils import faults
 
 logger = logging.getLogger(__name__)
+
+
+def _submit_accepts_trace(engine: object) -> bool:
+    """True when the engine's ``submit`` takes a ``trace`` kwarg (named
+    or via ``**kwargs``).  Probed once per engine build — never per
+    request — so forwarding a trace context costs routing nothing."""
+    submit = getattr(engine, "submit", None)
+    if submit is None:
+        return False
+    try:
+        params = inspect.signature(submit).parameters
+    except (TypeError, ValueError):  # builtins / exotic callables
+        return False
+    return "trace" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
 
 
 class Replica:
@@ -49,6 +67,18 @@ class Replica:
         self.state = "dead"
         self.restarts = 0
         self.started_at: Optional[float] = None
+        #: Timeline lane (synthetic Chrome-trace pid) the replica's
+        #: engines stamp their spans with.  Allocated once, on the first
+        #: start of a lane-capable engine, and REUSED across restarts —
+        #: one Perfetto row per replica identity, not per engine
+        #: incarnation.  None until then (and forever, for fakes
+        #: without ``set_trace_lane``).
+        self.trace_lane: Optional[int] = None
+        #: Whether this replica's engine ``submit()`` accepts the
+        #: ``trace`` kwarg (signature-probed at start, same idiom as
+        #: the fleet's router-pick probes) — duck-typed fakes predating
+        #: the kwarg keep working on the plain path.
+        self.accepts_trace = False
         if start:
             self.start()
 
@@ -74,6 +104,13 @@ class Replica:
             with self._lock:
                 self.state = "dead"
             raise
+        self.accepts_trace = _submit_accepts_trace(engine)
+        if hasattr(engine, "set_trace_lane"):
+            if self.trace_lane is None:
+                self.trace_lane = tracing.register_lane(
+                    f"replica {self.id}"
+                )
+            engine.set_trace_lane(self.trace_lane)
         with self._lock:
             self.engine = engine
             self.state = "ready"

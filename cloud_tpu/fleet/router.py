@@ -100,6 +100,13 @@ class LeastLoadedRouter:
                 f"cache_alpha must be >= 0, got {cache_alpha}"
             )
         self._cache_alpha = float(cache_alpha)
+        #: Cached prefix tokens credited to the LAST pick's winner (0
+        #: without the cost model or on a cold pick).  The fleet stamps
+        #: it on the traced ``fleet/route`` span so a TTFT drill-down
+        #: shows whether cache-aware routing — not just load — chose
+        #: the replica.  Read on the fleet's single router thread, same
+        #: as every other pick-path access.
+        self.last_pick_cached_tokens = 0
         self._affinity: Optional[collections.OrderedDict] = (
             collections.OrderedDict() if prefix_affinity else None
         )
@@ -157,6 +164,7 @@ class LeastLoadedRouter:
         when no routable candidate exists (all excluded, draining,
         restarting, or unhealthy)."""
         excluded = set(exclude)
+        self.last_pick_cached_tokens = 0
         tied: list = []  # (replica, health) rows at the best score
         best_score: Optional[float] = None
         for replica in replicas:
@@ -182,6 +190,12 @@ class LeastLoadedRouter:
                     if replica.id == preferred:
                         best, best_health = replica, health
                         break
+        if self._cache_alpha and affinity_key is not None:
+            self.last_pick_cached_tokens = int(
+                (best_health.get("cached_prefixes") or {}).get(
+                    affinity_key
+                ) or 0
+            )
         return best, best_health
 
     def record_affinity(self, affinity_key: Optional[int],

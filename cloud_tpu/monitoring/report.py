@@ -36,6 +36,17 @@ per-class request counts with TTFT and end-to-end latency p50/p99 —
 the per-class SLO numbers the priority weights and quotas are tuned
 against.  FIFO timelines carry no such spans and render no section.
 
+Timelines carrying ``trace_id`` attributes (requests submitted while
+tracing was active — the fleet mints a :class:`tracing.TraceContext`
+per request and every layer stamps it) additionally get per-request
+stitching: a **traced requests** line, a **TTFT decomposition** table
+attributing fleet TTFT to queue / route / swap-in / prefill /
+first-decode shares at p50/p99 (the distributional gate bench.py and
+check_fleet.py compare instead of raw percentiles), and a ``--trace
+<id>`` drill-down that prints one request's whole lifecycle — every
+span under its trace id across fleet and replicas, failovers included
+— in start order.
+
 Timelines with ``fleet/*`` spans (the ``cloud_tpu.fleet`` layer) get a
 **fleet** section: per-replica routed-request counts with mean
 load/occupancy (from the attributes the router stamps on every
@@ -393,7 +404,14 @@ class TraceReport:
             if event.get("name") != "serve/request":
                 continue
             args = event.get("args") or {}
-            name = str(args.get("priority") or "?")
+            priority = args.get("priority")
+            if priority is None:
+                # Traced FIFO requests also emit a terminal
+                # serve/request span (it anchors the per-request
+                # lifecycle) but carry no priority — they belong to
+                # request_summary(), not to a phantom QoS class.
+                continue
+            name = str(priority)
             row = by_class.setdefault(
                 name, {"ttft": [], "latency": []}
             )
@@ -493,6 +511,281 @@ class TraceReport:
             "scale": scale,
             "occupancy_spread": spread,
         }
+
+    # -- per-request trace stitching ------------------------------------
+
+    #: Prefill-phase span names charged to the "prefill" TTFT component
+    #: (batch prefill, chunked prefill, and the finalize insert).
+    _PREFILL_SPANS = (
+        "serve/prefill", "serve/prefill_chunk", "serve/prefill_finalize",
+    )
+
+    def trace_spans(self, trace_id: str) -> List[dict]:
+        """Every span stitched under ``trace_id``, in start order.
+
+        A span belongs to a trace either directly (its ``trace_id``
+        attribute — fleet/route, serve/request, serve/queue_wait, ...)
+        or through the ``traces`` slot map the continuous scheduler
+        stamps on shared dispatches (serve/chunk, serve/verify serve
+        many slots at once; the map says which requests rode along).
+        """
+        wanted = str(trace_id)
+        spans = []
+        for event in self.events:
+            args = event.get("args") or {}
+            tid = args.get("trace_id")
+            if tid is not None and str(tid) == wanted:
+                spans.append(event)
+                continue
+            traces = args.get("traces")
+            if isinstance(traces, dict) and any(
+                    str(t) == wanted for t in traces.values()):
+                spans.append(event)
+        spans.sort(key=lambda e: e["ts"])
+        return spans
+
+    def _spans_by_trace(self) -> Dict[str, List[dict]]:
+        by_trace: Dict[str, List[dict]] = {}
+        for event in self.events:
+            args = event.get("args") or {}
+            tid = args.get("trace_id")
+            if tid is not None:
+                by_trace.setdefault(str(tid), []).append(event)
+            traces = args.get("traces")
+            if isinstance(traces, dict):
+                for tid in {str(t) for t in traces.values()}:
+                    by_trace.setdefault(tid, []).append(event)
+        return by_trace
+
+    def request_summary(self) -> Optional[Dict[str, dict]]:
+        """Per-request lifecycle, stitched by ``trace_id``.
+
+        One row per traced request (fleet or engine submissions made
+        with tracing active), with the milestone gaps of its life as
+        durations in seconds:
+
+        * ``queue_s`` — fleet-queue wait before the first routing
+          attempt (the attempt-1 ``fleet/route`` span's ``queue_s``
+          attribute; None on engine-only timelines).
+        * ``route_s`` / ``routes`` — total routing time and attempt
+          count; ``failovers`` counts ``fleet/failover`` re-admissions.
+        * ``engine_queue_s`` — admission waits inside the engine(s).
+        * ``swapin_s`` — host-DRAM prefix swap-in stall paid at
+          admission.
+        * ``prefill_s`` — prefill compute (batch, chunked, finalize).
+        * ``ttft_s`` / ``latency_s`` / ``tokens`` — from the terminal
+          ``serve/request`` span (engine-clock TTFT, end-to-end
+          latency, emitted tokens); ``fleet_ttft_s`` adds the fleet
+          queue + routing time on top of the engine TTFT.
+        * ``chunks`` — shared decode dispatches the request rode
+          (via the slot map); ``spec_accepted`` — draft tokens the
+          verify dispatches it participated in committed (batch-level:
+          a shared verify credits every rider).
+        * ``shed`` — the request hit a shed span; ``complete`` — a
+          terminal ``serve/request`` span exists.
+
+        Rows degrade gracefully when the ring buffer evicted early
+        spans: missing milestones are None (or 0 for counters), and
+        ``complete`` only needs the terminal span.  None when the
+        timeline carries no trace ids at all.
+        """
+        by_trace = self._spans_by_trace()
+        if not by_trace:
+            return None
+        requests: Dict[str, dict] = {}
+        for tid, spans in sorted(by_trace.items()):
+            routes = [e for e in spans if e["name"] == "fleet/route"]
+            terminals = [
+                e for e in spans if e["name"] == "serve/request"
+            ]
+            queue_s = next(
+                (
+                    (e.get("args") or {}).get("queue_s")
+                    for e in routes
+                    if isinstance((e.get("args") or {}).get("queue_s"),
+                                  (int, float))
+                ),
+                None,
+            )
+
+            def total_of(*names):
+                return sum(
+                    e["dur"] / 1e6 for e in spans if e["name"] in names
+                )
+
+            spec_accepted = 0
+            for event in spans:
+                if event["name"] != "serve/verify":
+                    continue
+                accepted = (event.get("args") or {}).get("accepted")
+                if isinstance(accepted, (int, float)):
+                    spec_accepted += int(accepted)
+            row = {
+                "spans": len(spans),
+                "routes": len(routes),
+                "failovers": sum(
+                    1 for e in spans if e["name"] == "fleet/failover"
+                ),
+                "queue_s": queue_s,
+                "route_s": total_of("fleet/route"),
+                "engine_queue_s": total_of("serve/queue_wait"),
+                "swapin_s": total_of("serve/prefix_swapin"),
+                "prefill_s": total_of(*self._PREFILL_SPANS),
+                "chunks": sum(
+                    1 for e in spans if e["name"] == "serve/chunk"
+                ),
+                "spec_accepted": spec_accepted,
+                "shed": any(
+                    e["name"] in ("serve/shed", "fleet/shed")
+                    for e in spans
+                ),
+                "ttft_s": None,
+                "fleet_ttft_s": None,
+                "latency_s": None,
+                "tokens": None,
+                "complete": bool(terminals),
+            }
+            if terminals:
+                # Re-admitted requests keep one trace identity; the
+                # engine that actually finished them retired them last.
+                terminal = max(terminals, key=lambda e: e["ts"])
+                args = terminal.get("args") or {}
+                row["latency_s"] = terminal["dur"] / 1e6
+                ttft = args.get("ttft_s")
+                if isinstance(ttft, (int, float)):
+                    row["ttft_s"] = float(ttft)
+                    row["fleet_ttft_s"] = (
+                        float(ttft) + (queue_s or 0.0) + row["route_s"]
+                    )
+                tokens = args.get("tokens")
+                if isinstance(tokens, (int, float)):
+                    row["tokens"] = int(tokens)
+            requests[tid] = row
+        return requests
+
+    #: The TTFT components, in lifecycle order (render + bench key
+    #: order; first_decode is the remainder after the attributable
+    #: phases).
+    TTFT_COMPONENTS = (
+        "queue", "route", "swapin", "prefill", "first_decode",
+    )
+
+    def ttft_decomposition(
+            self, summary: Optional[Dict[str, dict]] = None,
+    ) -> Optional[Dict[str, object]]:
+        """Fleet-level TTFT attribution across all stitched requests.
+
+        For every traced request with a terminal span, fleet TTFT is
+        ``queue_s + route_s + engine ttft_s`` and decomposes into:
+
+        * ``queue`` — fleet-queue wait plus engine admission waits,
+        * ``route`` — routing decisions (all attempts),
+        * ``swapin`` — host-DRAM prefix swap-in stalls,
+        * ``prefill`` — prefill compute,
+        * ``first_decode`` — the remainder (scheduler slack + the first
+          decode step), clamped at zero.
+
+        Returns per-component **shares** of fleet TTFT at p50/p99
+        across requests, plus the fleet-TTFT percentiles themselves —
+        the distributional gate the chaos harness and the QPS sweep
+        check instead of raw percentiles (a regression that moves time
+        *between* phases at equal TTFT still shows here).  None when no
+        request decomposes (tracing off, or all terminals evicted).
+        Pass a precomputed :meth:`request_summary` to skip restitching.
+        """
+        if summary is None:
+            summary = self.request_summary()
+        if not summary:
+            return None
+        shares: Dict[str, List[float]] = {
+            name: [] for name in self.TTFT_COMPONENTS
+        }
+        totals: List[float] = []
+        for row in summary.values():
+            if row["ttft_s"] is None:
+                continue
+            queue = (row["queue_s"] or 0.0) + row["engine_queue_s"]
+            route = row["route_s"]
+            total = (row["queue_s"] or 0.0) + route + row["ttft_s"]
+            if total <= 0:
+                continue
+            components = {
+                "queue": queue,
+                "route": route,
+                "swapin": row["swapin_s"],
+                "prefill": row["prefill_s"],
+            }
+            components["first_decode"] = max(
+                total - sum(components.values()), 0.0
+            )
+            totals.append(total)
+            for name, value in components.items():
+                shares[name].append(value / total)
+        if not totals:
+            return None
+        totals.sort()
+        return {
+            "requests": len(totals),
+            "ttft_p50_s": _percentile(totals, 0.5),
+            "ttft_p99_s": _percentile(totals, 0.99),
+            "shares": {
+                name: {
+                    "p50": _percentile(sorted(values), 0.5),
+                    "p99": _percentile(sorted(values), 0.99),
+                }
+                for name, values in shares.items()
+            },
+        }
+
+    def render_trace(self, trace_id: str) -> Optional[str]:
+        """One request's stitched lifecycle as text (the ``--trace``
+        drill-down): every span in start order with offset, duration
+        and attributes, then the request's summary row.  None when the
+        timeline holds no span for the id."""
+        spans = self.trace_spans(trace_id)
+        if not spans:
+            return None
+        t0 = spans[0]["ts"]
+        lines = [f"trace {trace_id}: {len(spans)} span(s)"]
+        for event in spans:
+            args = dict(event.get("args") or {})
+            for noise in ("trace_id", "traces", "span_id", "parent_id"):
+                args.pop(noise, None)
+            attrs = " ".join(
+                f"{k}={v}" for k, v in sorted(args.items())
+            )
+            offset = _fmt_s((event["ts"] - t0) / 1e6)
+            lines.append(
+                f"  +{offset:>8}  {event['name']:<24}"
+                f"  {_fmt_s(event['dur'] / 1e6):>8}"
+                + (f"  {attrs}" if attrs else "")
+            )
+        row = (self.request_summary() or {}).get(str(trace_id))
+        if row:
+            parts = [
+                f"routes {row['routes']}",
+                f"failovers {row['failovers']}",
+            ]
+            if row["ttft_s"] is not None:
+                parts.append(f"engine ttft {_fmt_s(row['ttft_s'])}")
+            if row["fleet_ttft_s"] is not None:
+                parts.append(
+                    f"fleet ttft {_fmt_s(row['fleet_ttft_s'])}"
+                )
+            if row["latency_s"] is not None:
+                parts.append(f"latency {_fmt_s(row['latency_s'])}")
+            if row["tokens"] is not None:
+                parts.append(f"{row['tokens']} tokens")
+            if row["spec_accepted"]:
+                parts.append(
+                    f"{row['spec_accepted']} spec-accepted tokens"
+                )
+            if row["shed"]:
+                parts.append("SHED")
+            if not row["complete"]:
+                parts.append("incomplete (no terminal span)")
+            lines.append("  " + " · ".join(parts))
+        return "\n".join(lines)
 
     @staticmethod
     def _render_table(rows, header) -> List[str]:
@@ -622,6 +915,41 @@ class TraceReport:
                     f"p99 {_fmt_s(row['latency_p99_s'])}"
                 )
                 lines.append(detail)
+        summary = self.request_summary()
+        if summary:
+            complete = sum(1 for r in summary.values() if r["complete"])
+            failed_over = sum(
+                1 for r in summary.values() if r["failovers"]
+            )
+            shed_traces = sum(1 for r in summary.values() if r["shed"])
+            line = (
+                f"traced requests: {len(summary)} · {complete} complete"
+            )
+            if failed_over:
+                line += f" · {failed_over} failed over"
+            if shed_traces:
+                line += f" · {shed_traces} shed"
+            lines.append("")
+            lines.append(line)
+        decomposition = self.ttft_decomposition(summary)
+        if decomposition:
+            lines.append("")
+            lines.append(
+                f"TTFT decomposition ({decomposition['requests']} traced "
+                "request(s), share of fleet TTFT):"
+            )
+            lines.extend(self._render_table([
+                (
+                    name,
+                    f"{decomposition['shares'][name]['p50'] * 100:.1f}",
+                    f"{decomposition['shares'][name]['p99'] * 100:.1f}",
+                )
+                for name in self.TTFT_COMPONENTS
+            ], ("component", "% p50", "% p99")))
+            lines.append(
+                f"  fleet ttft p50 {_fmt_s(decomposition['ttft_p50_s'])}"
+                f" / p99 {_fmt_s(decomposition['ttft_p99_s'])}"
+            )
         continuous = self.continuous_summary()
         if continuous:
             parts = [f"{continuous['chunks']} chunks"]
@@ -718,6 +1046,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Summarize a tracing.dump_timeline() Chrome-trace file.",
     )
     parser.add_argument("timeline", help="path to timeline.json")
+    parser.add_argument(
+        "--trace", metavar="ID", default=None,
+        help="render one traced request's stitched lifecycle (every "
+             "span carrying this trace_id, plus the shared dispatches "
+             "it rode) instead of the timeline summary",
+    )
     args = parser.parse_args(argv)
     try:
         report = TraceReport.from_file(args.timeline)
@@ -726,6 +1060,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if not report.events:
         print("no spans in timeline (was tracing enabled?)")
+        return 0
+    if args.trace is not None:
+        rendered = report.render_trace(args.trace)
+        if rendered is None:
+            print(
+                f"trace {args.trace!r} not found in timeline "
+                "(was tracing enabled on the fleet?)",
+                file=sys.stderr,
+            )
+            return 2
+        print(rendered)
         return 0
     print(report.render())
     return 0

@@ -34,12 +34,14 @@ true submit-to-first-step latency.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import itertools
 import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional
 
 from cloud_tpu.monitoring import metrics
 
@@ -83,6 +85,97 @@ def _stack() -> list:
     if stack is None:
         stack = _tls.stack = []
     return stack
+
+
+# --- trace context (fleet-wide request identity) ---------------------------
+
+#: Process-unique trace-id suffix source.  ``itertools.count`` because its
+#: ``next`` is atomic in CPython — same reliance as the stdlib's own id
+#: allocators — so minting needs no lock on the submit hot path.
+_trace_ids = itertools.count(1)
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Propagatable identity of ONE request across fleet hops.
+
+    Minted once at the fleet (or engine) ingress while tracing is
+    enabled, then carried — not re-minted — through routing, failover
+    re-admission, and the replica's scheduler, so every span a request
+    touches stamps the same ``trace_id`` and ``report.py`` can stitch
+    the full lifecycle back together.  ``parent_id`` optionally links to
+    an enclosing span (0 = root).  Frozen: a context is an identity, and
+    failover must re-submit the SAME identity.
+    """
+
+    trace_id: str
+    parent_id: int = 0
+
+
+def new_trace_context(parent_id: int = 0) -> Optional[TraceContext]:
+    """Mint a fresh :class:`TraceContext`, or None while tracing is off.
+
+    The None return IS the default-off contract: callers store it in
+    their request record unconditionally and the field rides inert —
+    no ids are allocated, no span gains attributes, and disabled-mode
+    span sets stay byte-identical.
+    """
+    if _collector is None:
+        return None
+    return TraceContext(
+        trace_id=f"{os.getpid():x}-{next(_trace_ids):x}",
+        parent_id=parent_id,
+    )
+
+
+# --- timeline lanes (multi-replica pid rows in one process) ----------------
+
+#: Lane ids start far above any plausible OS pid so a lane row can never
+#: collide with (and silently absorb) the process's own default lane.
+_LANE_BASE = 1 << 24
+
+_lane_lock = threading.Lock()
+_lane_labels: Dict[int, str] = {}
+_next_lane = _LANE_BASE
+
+
+def register_lane(label: str) -> int:
+    """Allocate a timeline lane: a synthetic Chrome-trace ``pid`` row.
+
+    All fleet replicas live in ONE process and share the process-global
+    collector, so without lanes every span lands on the same ``pid`` and
+    Perfetto renders the fleet as a single process.  A lane gives each
+    replica its own labelled row; threads adopt it via
+    :func:`set_thread_lane`.  Cheap and always available (a dict entry)
+    so replica startup never branches on whether tracing is enabled.
+    """
+    global _next_lane
+    with _lane_lock:
+        lane = _next_lane
+        _next_lane += 1
+        _lane_labels[lane] = str(label)
+        return lane
+
+
+def lane_label(lane: int) -> Optional[str]:
+    with _lane_lock:
+        return _lane_labels.get(lane)
+
+
+def set_thread_lane(lane: Optional[int]) -> None:
+    """Stamp spans finished on THIS thread with ``pid=lane`` (None resets
+    to the real ``os.getpid()``).  Thread-local, so one replica's
+    scheduler adopting its lane never relabels another's."""
+    _tls.lane = lane
+
+
+def current_thread_lane() -> Optional[int]:
+    return getattr(_tls, "lane", None)
+
+
+def _event_pid() -> int:
+    lane = getattr(_tls, "lane", None)
+    return lane if lane is not None else os.getpid()
 
 
 class TimelineCollector:
@@ -143,6 +236,21 @@ class TimelineCollector:
     @property
     def evicted(self) -> int:
         return self._evicted
+
+    def snapshot(self) -> dict:
+        """One consistent cut for merge/export: epoch + events + evicted.
+
+        ``epoch`` rides along because merged timelines (fleet + replicas,
+        eventually one collector per host) must normalize each source's
+        monotonic clock onto a common origin — see
+        :func:`merge_timelines`.
+        """
+        with self._lock:
+            return {
+                "epoch": self.epoch,
+                "events": list(self._events),
+                "evicted": self._evicted,
+            }
 
 
 _collector: Optional[TimelineCollector] = None
@@ -224,7 +332,7 @@ class Span:
                 "ph": "X",
                 "ts": (self._start - collector.epoch) * 1e6,
                 "dur": duration * 1e6,
-                "pid": os.getpid(),
+                "pid": _event_pid(),
                 "tid": threading.get_ident(),
                 "args": args,
             },
@@ -336,7 +444,7 @@ def record_span(name: str, start: float, end: float,
             "ph": "X",
             "ts": (start - collector.epoch) * 1e6,
             "dur": duration * 1e6,
-            "pid": os.getpid(),
+            "pid": _event_pid(),
             "tid": threading.get_ident(),
             "args": args,
         },
@@ -396,6 +504,20 @@ def dump_timeline(path: str) -> str:
         {
             "ph": "M",
             "pid": pid,
+            "tid": 0,
+            "name": "process_name",
+            "args": {"name": label},
+        }
+        for pid, label in sorted(
+            (pid, lane_label(pid))
+            for pid in {e["pid"] for e in events}
+        )
+        if label is not None
+    ]
+    meta += [
+        {
+            "ph": "M",
+            "pid": pid,
             "tid": tid,
             "name": "thread_name",
             "args": {"name": _thread_name(tid)},
@@ -405,11 +527,72 @@ def dump_timeline(path: str) -> str:
     doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
     if collector is not None and collector.evicted:
         doc["otherData"] = {"evicted_events": collector.evicted}
+    return _write_timeline(doc, path)
+
+
+def _write_timeline(doc: dict, path: str) -> str:
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f)
     return path
+
+
+def merge_timelines(sources: Iterable[dict], path: str) -> str:
+    """Merge per-source span snapshots into ONE Chrome-trace JSON.
+
+    Each source is ``{"label", "epoch", "events", "evicted"?, "pid"?}``
+    — the shape :meth:`TimelineCollector.snapshot` returns plus a lane
+    label (``pid`` defaults to the source's position, so sources from
+    different processes that reused the same OS pid still get distinct
+    rows).  Every source becomes a ``process_name``-labelled ``pid``
+    lane, and each event's ``ts`` is shifted by the source's monotonic
+    epoch offset against the EARLIEST source epoch, so spans from
+    collectors born at different times line up on one wall: the
+    normalization ``Fleet.dump_timeline`` relies on to show a request
+    bouncing between replicas in a single Perfetto view.
+    """
+    sources = list(sources)
+    epochs = [float(s["epoch"]) for s in sources]
+    base = min(epochs) if epochs else 0.0
+    merged: List[dict] = []
+    meta: List[dict] = []
+    evicted = 0
+    for index, source in enumerate(sources):
+        pid = int(source.get("pid", index))
+        offset_us = (float(source["epoch"]) - base) * 1e6
+        meta.append({
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "name": "process_name",
+            "args": {"name": str(source["label"])},
+        })
+        tids = set()
+        for event in source["events"]:
+            event = dict(event)
+            event["pid"] = pid
+            ts = event.get("ts")
+            if isinstance(ts, (int, float)):
+                event["ts"] = ts + offset_us
+            if isinstance(event.get("tid"), int):
+                tids.add(event["tid"])
+            merged.append(event)
+        meta += [
+            {
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "name": "thread_name",
+                "args": {"name": _thread_name(tid)},
+            }
+            for tid in sorted(tids)
+        ]
+        evicted += int(source.get("evicted") or 0)
+    doc = {"traceEvents": meta + merged, "displayTimeUnit": "ms"}
+    if evicted:
+        doc["otherData"] = {"evicted_events": evicted}
+    return _write_timeline(doc, path)
 
 
 def _thread_name(tid: int) -> str:

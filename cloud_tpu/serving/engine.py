@@ -120,6 +120,7 @@ churn.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import logging
 import threading
@@ -477,6 +478,12 @@ class ServeResult:
     #: from decode.  The batch scheduler only materializes tokens when
     #: the whole batch decode returns, so there it equals latency.
     ttft_seconds: float = 0.0
+    #: Fleet-wide trace id when the request carried a ``TraceContext``
+    #: (``tracing.new_trace_context``); None otherwise — the key that
+    #: joins this result to its spans in a merged timeline.  Rides
+    #: ``dataclasses.replace`` untouched, so the fleet's latency rebase
+    #: on failover keeps the identity.
+    trace_id: Optional[str] = None
 
 
 #: eq=False: requests are removed from mid-queue by IDENTITY (QoS
@@ -503,9 +510,27 @@ class _Request:
     #: Cross-layer per-token hook (the fleet's stream forwarding):
     #: called as ``on_token(index, token)`` from the scheduler thread.
     on_token: Optional[object] = None
+    #: Fleet-minted ``tracing.TraceContext`` (None = untraced).  Inert
+    #: unless a collector is active: no span gains attributes from it
+    #: while tracing is off, so the disabled span set stays
+    #: byte-identical.
+    trace: Optional[tracing.TraceContext] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.trace_id if self.trace is not None else None
+
+
+def _trace_attrs(request: _Request, **attrs) -> dict:
+    """Span attributes + the request's ``trace_id`` when it carries a
+    trace context.  Untraced requests get exactly the attrs passed in,
+    so pre-tracing span payloads stay byte-identical."""
+    if request.trace is not None:
+        attrs["trace_id"] = request.trace.trace_id
+    return attrs
 
 
 @dataclasses.dataclass
@@ -593,6 +618,97 @@ class _Cell:
         )
 
 
+class _BurstDispatcher:
+    """ONE supervised worker thread for a burst of dispatches.
+
+    ``_supervised`` pays a fresh watchdog thread per dispatch — right
+    for isolated chunk/prefill programs, wasteful for a demotion burst
+    where a single allocation can evict dozens of blocks back-to-back
+    (the swap-in path already batches its whole plan under one
+    watchdog).  The burst dispatcher starts its worker lazily on the
+    first call, runs each closure serially on that worker, and applies
+    the engine's full per-dispatch watchdog contract per call — same
+    ``dispatch_timeout_s`` budget, orphan tracking, unhealthy-reason
+    latch, and :class:`DispatchTimeoutError` as ``_supervised``.  The
+    caller still blocks until each closure returns, so demote downloads
+    stay strictly ordered BEFORE the row reuse that follows them.
+    Scheduler-thread only, like the dispatch path it serves.
+    """
+
+    def __init__(self, engine: "ServingEngine"):
+        self._engine = engine
+        self._cond = threading.Condition()
+        self._item = None          # (fn, box, done) awaiting the worker
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self._timed_out = False
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while self._item is None and not self._stopped:
+                    self._cond.wait()
+                if self._item is None:
+                    return
+                fn, box, done = self._item
+                self._item = None
+            try:
+                box["result"] = fn()
+            except BaseException as exc:  # noqa: BLE001 — rethrown below
+                box["error"] = exc
+            finally:
+                done.set()
+
+    def call(self, label: str, fn):
+        """Run ``fn`` under the shared worker with ``_supervised``'s
+        exact watchdog semantics (one budget per call)."""
+        engine = self._engine
+        timeout = engine.serve_config.dispatch_timeout_s
+        engine._last_dispatch_ts = time.perf_counter()
+        if timeout is None:
+            return fn()
+        if self._timed_out:
+            # The worker is wedged on an earlier dispatch of this same
+            # burst; queueing behind it could only hang again.  The
+            # first timeout already latched the engine unhealthy.
+            raise DispatchTimeoutError(
+                f"{label} skipped: burst dispatcher already timed out"
+            )
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._worker, daemon=True,
+                name=SERVE_DISPATCH_THREAD_NAME,
+            )
+            self._thread.start()
+        box: dict = {}
+        done = threading.Event()
+        with self._cond:
+            self._item = (fn, box, done)
+            self._cond.notify_all()
+        if not done.wait(timeout):
+            self._timed_out = True
+            engine._orphan_dispatches.append(self._thread)
+            engine._unhealthy_reason = (
+                f"{label} exceeded dispatch_timeout_s={timeout}"
+            )
+            metrics.counter_inc("serve/watchdog_timeouts")
+            with engine._stats_lock:
+                engine._stats["watchdog_timeouts"] += 1
+            raise DispatchTimeoutError(engine._unhealthy_reason)
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def shutdown(self) -> None:
+        """End the burst: stop and join the worker (unless it is wedged,
+        in which case it is already orphan-tracked for ``close()``)."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        if self._thread is not None and not self._timed_out:
+            self._thread.join()
+
+
 class ServingEngine:
     """In-process continuous-batching server over ``generation`` (module
     docstring; ``scheduler="batch"`` selects the batch-synchronous
@@ -654,6 +770,15 @@ class ServingEngine:
         #: close() so a finite hang never leaks past the engine's life.
         self._orphan_dispatches: List[threading.Thread] = []
         self._last_dispatch_ts: Optional[float] = None
+        #: Timeline lane (synthetic Chrome-trace pid) this engine's
+        #: scheduler stamps its spans with; None = the real process pid.
+        #: Set by the owning fleet replica via :meth:`set_trace_lane`.
+        self._trace_lane: Optional[int] = None
+        #: Live demotion-burst dispatcher (satellite of ISSUE 16): while
+        #: a prefix-cache insert/swap-in reservation runs, demote
+        #: downloads share ONE supervised worker instead of paying a
+        #: watchdog thread per block.  Scheduler-thread only.
+        self._demote_dispatcher: Optional[_BurstDispatcher] = None
         #: Rows of the batch currently on the device (batch scheduler;
         #: the continuous path reads its slot table instead).  Plain int
         #: swap — written by the scheduler, read by ``health()``.
@@ -679,6 +804,9 @@ class ServingEngine:
             "spec_proposed": 0, "spec_accepted": 0, "draft_prefills": 0,
             # Robustness counters: queue-shed deadlines, watchdog fires.
             "shed": 0, "watchdog_timeouts": 0,
+            # Requests submitted carrying a TraceContext (0 with
+            # tracing off — stable schema either way).
+            "traced": 0,
             # QoS brownout sheds (0 unless qos arms a brownout depth).
             "brownout_shed": 0,
         }
@@ -1123,6 +1251,15 @@ class ServingEngine:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def set_trace_lane(self, lane: Optional[int]) -> None:
+        """Adopt a timeline lane (``tracing.register_lane``): the
+        scheduler thread stamps its spans with ``pid=lane`` so a merged
+        fleet timeline renders this engine as its own labelled process
+        row.  Duck-typed — the fleet replica calls it via ``hasattr``
+        after building the engine, so non-engine fakes stay valid.
+        Thread-safe (int swap); the scheduler re-reads it every pass."""
+        self._trace_lane = lane
+
     def start(self) -> "ServingEngine":
         """Launch the scheduler thread (idempotent)."""
         with self._cond:
@@ -1192,7 +1329,8 @@ class ServingEngine:
                deadline_s: Optional[float] = None,
                priority: Optional[str] = None,
                stream: bool = False,
-               on_token=None) -> Future:
+               on_token=None,
+               trace: Optional[tracing.TraceContext] = None) -> Future:
         """Enqueue one prompt; returns a Future of :class:`ServeResult`
         (or a :class:`~cloud_tpu.serving.qos.TokenStream` with
         ``stream=True``).
@@ -1225,6 +1363,13 @@ class ServingEngine:
         the exact tokens the final result row carries.  ``on_token`` is
         the cross-layer per-token hook the fleet uses to forward a
         stream — called as ``(index, token)`` on the scheduler thread.
+
+        ``trace`` carries the fleet-minted
+        :class:`~cloud_tpu.monitoring.tracing.TraceContext` so every
+        span this request touches stamps its ``trace_id`` (and the
+        result reports it).  Inert while tracing is disabled; None (the
+        default) keeps the engine's span set byte-identical to the
+        pre-tracing behavior.
         """
         cfg = self.serve_config
         if deadline_s is not None and deadline_s <= 0:
@@ -1261,8 +1406,10 @@ class ServingEngine:
                 None if deadline_s is None else submitted + deadline_s
             ),
             priority=priority, stream=token_stream, on_token=on_token,
+            trace=trace,
         )
         if token_stream is not None:
+            token_stream.trace_id = request.trace_id
             # EVERY resolution path (retire, shed, crash, close) goes
             # through the future; the callback closes the stream with
             # the same result/exception and back-fills any tokens the
@@ -1294,6 +1441,8 @@ class ServingEngine:
             self._cond.notify_all()
         with self._stats_lock:
             self._stats["requests"] += 1
+            if trace is not None:
+                self._stats["traced"] += 1
         metrics.counter_inc("serve/requests")
         return token_stream if token_stream is not None else request.future
 
@@ -1518,11 +1667,38 @@ class ServingEngine:
             return jax.tree_util.tree_map(np.asarray, payload)
 
         with tracing.span("serve/prefix_demote", block=int(block)):
-            payload = self._supervised("serve/prefix_demote", dispatch)
+            if self._demote_dispatcher is not None:
+                payload = self._demote_dispatcher.call(
+                    "serve/prefix_demote", dispatch
+                )
+            else:
+                payload = self._supervised("serve/prefix_demote", dispatch)
         metrics.counter_inc("serve/prefix_demotions")
         return payload
 
-    def _dispatch_swapin(self, slot: int, plan) -> None:
+    @contextlib.contextmanager
+    def _demote_burst(self):
+        """Scope one prefix-cache allocation burst: every
+        ``_demote_block`` inside shares ONE supervised worker thread
+        (one watchdog dispatch thread per burst, mirroring how
+        ``_dispatch_swapin`` budgets a whole plan) instead of paying a
+        fresh thread per evicted block.  No-op when the watchdog is
+        disabled (``dispatch_timeout_s=None`` runs inline anyway) or
+        when already inside a burst."""
+        if (self.serve_config.dispatch_timeout_s is None
+                or self._demote_dispatcher is not None):
+            yield
+            return
+        burst = _BurstDispatcher(self)
+        self._demote_dispatcher = burst
+        try:
+            yield
+        finally:
+            self._demote_dispatcher = None
+            burst.shutdown()
+
+    def _dispatch_swapin(self, slot: int, plan,
+                         trace_id: Optional[str] = None) -> None:
         """Upload a promotion plan's payloads into their fresh pool rows
         (``serve/prefix_swapin`` span — the swap-in stall the report
         attributes).  ``device_put`` is asynchronous: the host enqueues
@@ -1544,8 +1720,10 @@ class ServingEngine:
                             np.int32(block))
             return pool
 
-        with tracing.span("serve/prefix_swapin", slot=slot,
-                          blocks=len(plan), tokens=tokens):
+        span_attrs = dict(slot=slot, blocks=len(plan), tokens=tokens)
+        if trace_id is not None:
+            span_attrs["trace_id"] = trace_id
+        with tracing.span("serve/prefix_swapin", **span_attrs):
             self._prefix_pool = self._supervised(
                 "serve/prefix_swapin", dispatch
             )
@@ -1746,7 +1924,8 @@ class ServingEngine:
                 waited = now - request.submitted
                 tracing.record_span(
                     "serve/shed", request.submitted, now,
-                    bucket=request.bucket_len, reason="deadline",
+                    **_trace_attrs(request, bucket=request.bucket_len,
+                                   reason="deadline"),
                 )
                 try:
                     request.future.set_exception(DeadlineExceededError(
@@ -1796,8 +1975,9 @@ class ServingEngine:
             shed_classes.append(request.priority)
             tracing.record_span(
                 "serve/shed", request.submitted, now,
-                bucket=request.bucket_len, reason="brownout",
-                priority=request.priority,
+                **_trace_attrs(request, bucket=request.bucket_len,
+                               reason="brownout",
+                               priority=request.priority),
             )
             try:
                 request.future.set_exception(BrownoutShedError(
@@ -1945,6 +2125,8 @@ class ServingEngine:
 
     def _batch_loop(self) -> None:
         while True:
+            if self._trace_lane is not None:
+                tracing.set_thread_lane(self._trace_lane)
             with self._cond:
                 while True:
                     now = time.perf_counter()
@@ -1998,6 +2180,11 @@ class ServingEngine:
         be half-donated), so it propagates to the crash handler, which
         fails every queued and in-flight request."""
         while True:
+            # Re-assert the timeline lane each pass: the owning replica
+            # tags the engine AFTER this thread is already running (and
+            # a restarted engine may inherit the replica's lane late).
+            if self._trace_lane is not None:
+                tracing.set_thread_lane(self._trace_lane)
             inserts: List[Tuple[_Request, int]] = []
             abort = False
             with self._cond:
@@ -2122,8 +2309,11 @@ class ServingEngine:
         held: List[object] = []
         swapin_plan = None
         if self._prefix is not None:
-            with tracing.span("serve/prefix_lookup",
-                              bucket=request.bucket_len, slot=slot) as span:
+            with tracing.span(
+                "serve/prefix_lookup",
+                **_trace_attrs(request, bucket=request.bucket_len,
+                               slot=slot),
+            ) as span:
                 candidate = self._prefix.match(request.prompt.tolist())
                 faults.fault_point("serve.prefix_acquire")
                 if candidate:
@@ -2133,9 +2323,10 @@ class ServingEngine:
                         # lost the race (blocks evicted since the match,
                         # or HBM fully pinned): fall back to a cold
                         # prefill — the PR 9 revalidation, extended.
-                        swapin_plan = self._prefix.acquire_swapin(
-                            candidate
-                        )
+                        with self._demote_burst():
+                            swapin_plan = self._prefix.acquire_swapin(
+                                candidate
+                            )
                         if swapin_plan is not None:
                             hit = candidate
                             held.extend(candidate.nodes)
@@ -2162,7 +2353,7 @@ class ServingEngine:
         now = time.perf_counter()
         tracing.record_span(
             "serve/queue_wait", request.submitted, now,
-            bucket=request.bucket_len, slot=slot,
+            **_trace_attrs(request, bucket=request.bucket_len, slot=slot),
         )
         # Tabled BEFORE any dispatch: a grid crash mid-prefill fails
         # this request along with the live slots.
@@ -2172,7 +2363,8 @@ class ServingEngine:
         if swapin_plan:
             # The promoted rows must hold their bytes before the copy
             # below reads them (dataflow-ordered on device).
-            self._dispatch_swapin(slot, swapin_plan)
+            self._dispatch_swapin(slot, swapin_plan,
+                                  trace_id=request.trace_id)
         if hit is not None and hit.tokens:
             self._dispatch_copy(request, slot, hit)
         width = (
@@ -2199,8 +2391,11 @@ class ServingEngine:
             return cell(self._grid_cache, self._prefix_pool, ids,
                         np.int32(slot))
 
-        with tracing.span("serve/prefix_copy", slot=slot,
-                          blocks=len(blocks), tokens=hit.tokens):
+        with tracing.span(
+            "serve/prefix_copy",
+            **_trace_attrs(request, slot=slot, blocks=len(blocks),
+                           tokens=hit.tokens),
+        ):
             self._grid_cache = self._supervised(
                 "serve/prefix_copy", dispatch
             )
@@ -2226,8 +2421,11 @@ class ServingEngine:
                 np.int32(clen), np.int32(task.slot),
             )
 
-        with tracing.span("serve/prefill_chunk", bucket=request.bucket_len,
-                          slot=task.slot, start=start_pos, tokens=clen):
+        with tracing.span(
+            "serve/prefill_chunk",
+            **_trace_attrs(request, bucket=request.bucket_len,
+                           slot=task.slot, start=start_pos, tokens=clen),
+        ):
             self._grid_cache, logits = self._supervised(
                 "serve/prefill_chunk", dispatch
             )
@@ -2256,7 +2454,8 @@ class ServingEngine:
                 np.int32(slot), np.int32(request.max_new_tokens), fin_rng,
             )
 
-        with tracing.span("serve/prefill_finalize", slot=slot):
+        with tracing.span("serve/prefill_finalize",
+                          **_trace_attrs(request, slot=slot)):
             self._slot_state, tok0 = self._supervised(
                 "serve/prefill_finalize", dispatch
             )
@@ -2281,9 +2480,10 @@ class ServingEngine:
         cfg = self.serve_config
         if already is None:
             already = PrefixHit(nodes=(), tokens=0)
-        held, created, evicted = self._prefix.insert(
-            request.prompt.tolist(), already
-        )
+        with self._demote_burst():
+            held, created, evicted = self._prefix.insert(
+                request.prompt.tolist(), already
+            )
         if evicted:
             metrics.counter_inc("serve/prefix_evictions", evicted)
         entry = self._slot_table[slot]
@@ -2337,7 +2537,7 @@ class ServingEngine:
         start = time.perf_counter()
         tracing.record_span(
             "serve/queue_wait", request.submitted, start,
-            bucket=request.bucket_len, slot=slot,
+            **_trace_attrs(request, bucket=request.bucket_len, slot=slot),
         )
         tokens = np.zeros((1, request.bucket_len), np.int32)
         tokens[0, :request.prompt_len] = request.prompt
@@ -2352,8 +2552,10 @@ class ServingEngine:
                 np.int32(request.max_new_tokens), insert_rng,
             )
 
-        with tracing.span("serve/prefill", bucket=request.bucket_len,
-                          slot=slot):
+        with tracing.span(
+            "serve/prefill",
+            **_trace_attrs(request, bucket=request.bucket_len, slot=slot),
+        ):
             self._grid_cache, self._slot_state, tok0 = self._supervised(
                 "serve/prefill", dispatch
             )
@@ -2366,6 +2568,23 @@ class ServingEngine:
         self._feed_entry(entry)
         self._save_prefix_blocks(request, slot)
         self._activate_or_retire(slot, request, tok0)
+
+    def _active_trace_map(self) -> Optional[Dict[str, str]]:
+        """slot -> trace_id for the traced requests a multi-slot dispatch
+        serves (chunk/verify spans carry it as the ``traces`` attribute,
+        since one dispatch advances MANY requests).  None when tracing is
+        off or no active request carries a context — the attribute is
+        then omitted entirely, keeping untraced span payloads
+        byte-identical.  JSON object keys must be strings, hence
+        ``str(slot)``."""
+        if not tracing.enabled():
+            return None
+        traces = {}
+        for slot in sorted(self._active_slots):
+            entry = self._slot_table[slot]
+            if entry is not None and entry.request.trace is not None:
+                traces[str(slot)] = entry.request.trace.trace_id
+        return traces or None
 
     def _dispatch_chunk(self) -> None:
         import jax
@@ -2388,6 +2607,9 @@ class ServingEngine:
                 f"{self._slice_shape[0]}x{self._slice_shape[1]}"
             )
             span_attrs["slice_chips"] = self._slice_chips
+        traces = self._active_trace_map()
+        if traces:
+            span_attrs["traces"] = traces
         with tracing.span("serve/chunk", **span_attrs) as chunk_span:
             self._grid_cache, self._slot_state, toks, valid = (
                 self._supervised("serve/chunk", dispatch)
@@ -2483,6 +2705,9 @@ class ServingEngine:
                 f"{self._slice_shape[0]}x{self._slice_shape[1]}"
             )
             span_attrs["slice_chips"] = self._slice_chips
+        traces = self._active_trace_map()
+        if traces:
+            span_attrs["traces"] = traces
         with tracing.span("serve/verify", **span_attrs) as verify_span:
             self._grid_cache, self._slot_state, toks, valid = (
                 self._supervised("serve/verify", verify_dispatch)
@@ -2590,6 +2815,7 @@ class ServingEngine:
             batch_size=cfg.num_slots,
             latency_seconds=done - request.submitted,
             ttft_seconds=first - request.submitted,
+            trace_id=request.trace_id,
         )
         metrics.distribution_record(
             "serve/latency_seconds", result.latency_seconds
@@ -2611,14 +2837,20 @@ class ServingEngine:
             self._stats["generated_tokens"] += num
             if self._qos is not None:
                 self._class_completed[request.priority] += 1
-        if self._qos is not None:
-            # Per-request class span (only with QoS armed — a FIFO
-            # timeline keeps its exact pre-QoS span set): report.py's
-            # per-class TTFT/latency breakdown reads these attributes.
+        if self._qos is not None or request.trace is not None:
+            # Per-request terminal span — with QoS armed (report.py's
+            # per-class TTFT/latency breakdown reads the priority
+            # attribute) or when the request carries a trace context
+            # (the lifecycle stitch needs a terminal under the
+            # trace_id).  A FIFO engine serving untraced requests keeps
+            # its exact pre-QoS span set.
+            attrs = {"ttft_s": round(result.ttft_seconds, 6),
+                     "tokens": num}
+            if request.priority is not None:
+                attrs["priority"] = request.priority
             tracing.record_span(
                 "serve/request", request.submitted, done,
-                priority=request.priority,
-                ttft_s=round(result.ttft_seconds, 6), tokens=num,
+                **_trace_attrs(request, **attrs),
             )
         try:
             request.future.set_result(result)
@@ -2641,7 +2873,7 @@ class ServingEngine:
         for request in batch:
             tracing.record_span(
                 "serve/queue_wait", request.submitted, form_start,
-                bucket=bucket_len,
+                **_trace_attrs(request, bucket=bucket_len),
             )
         with tracing.span("serve/batch_form", bucket=bucket_len,
                           rows=n, batch=batch_size):
@@ -2690,10 +2922,23 @@ class ServingEngine:
                 # Batch decode materializes tokens all at once: first
                 # token and last arrive together.
                 ttft_seconds=done - request.submitted,
+                trace_id=request.trace_id,
             )
             metrics.distribution_record(
                 "serve/latency_seconds", result.latency_seconds
             )
+            if request.trace is not None:
+                # Terminal span for the lifecycle stitch (continuous
+                # engines emit it in _retire_slot); untraced batch
+                # requests keep the pre-tracing span set.
+                attrs = {"ttft_s": round(result.ttft_seconds, 6),
+                         "tokens": num,
+                         "trace_id": request.trace.trace_id}
+                if request.priority is not None:
+                    attrs["priority"] = request.priority
+                tracing.record_span(
+                    "serve/request", request.submitted, done, **attrs
+                )
             results.append(result)
 
         # Stats/metrics BEFORE the futures resolve: a caller waking from

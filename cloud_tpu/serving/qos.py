@@ -417,6 +417,12 @@ class TokenStream:
         #: Resolves with the final ServeResult (or the typed failure) —
         #: the same object the non-streamed submit future carries.
         self.future: Future = Future()
+        #: Fleet-wide trace id when the submit carried a TraceContext
+        #: (engine/fleet stamp it at admission); None otherwise.  Lets a
+        #: streaming consumer correlate its tokens with the request's
+        #: spans in a merged timeline without waiting for the final
+        #: ServeResult.
+        self.trace_id: Optional[str] = None
 
     # -- producer side (scheduler / fleet threads) -------------------------
 

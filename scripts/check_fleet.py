@@ -38,12 +38,19 @@ parity harness and ``check_chaos.py``'s degradation harness:
    each replica's (deliberately small) tiered prefix cache under
    eviction pressure, with the SAME mid-run replica kill in both arms:
    a tie-break-only-affinity arm (the PR 9 router) and a cache-aware
-   cost-model arm (``cache_alpha``).  Asserted: cost-model crowd TTFT
-   p99 strictly below the tie-break arm's (concentrating the crowd on
-   the replica whose cache holds the prefix keeps it resident; load
-   spraying lets background churn flush it through both tiers), more
-   prefix hit tokens in the cost-model arm, token-for-token parity for
-   EVERY request in both arms, and zero leaked threads.
+   cost-model arm (``cache_alpha``).  Both arms run under an active
+   trace collector (ISSUE 16) and dump the merged per-replica
+   timeline.  Asserted: cost-model crowd TTFT p99 strictly below the
+   tie-break arm's — compared on the TRACE-DERIVED fleet TTFT from the
+   stitched timelines (concentrating the crowd on the replica whose
+   cache holds the prefix keeps it resident; load spraying lets
+   background churn flush it through both tiers), more prefix hit
+   tokens in the cost-model arm, EVERY completed request in both arms
+   stitched into a full traced lifecycle (>=1 ``fleet/route`` + a
+   terminal ``serve/request`` under one trace id, the failed-over
+   requests included, with >=1 failed-over trace per arm), the report
+   CLI rendering the TTFT decomposition table, token-for-token parity
+   for EVERY request in both arms, and zero leaked threads.
 
 Prints one JSON line per phase plus a summary::
 
@@ -557,10 +564,23 @@ def _run_flash_crowd_arm(params, config, *, cost_model: bool,
     """One arm of the flash-crowd comparison: the SAME crowd+pressure
     traffic and the SAME mid-run replica kill through a 2-replica
     tiered-prefix-cache fleet, routed either by the cache-aware cost
-    model (``cache_alpha``) or by the PR 9 tie-break-only affinity."""
+    model (``cache_alpha``) or by the PR 9 tie-break-only affinity.
+
+    The whole arm runs under an active trace collector (ISSUE 16):
+    every submission carries a trace context, the arm dumps the merged
+    per-replica timeline, and the return row adds the trace gates —
+    every completed request stitched a full routed lifecycle (the
+    failed-over ones included), at least one failed-over trace
+    stitched, and the report CLI rendered the TTFT decomposition table
+    — plus the trace-derived crowd TTFT p99 the arms are compared on."""
+    import shutil
+    import tempfile
+
     import numpy as np
 
     from cloud_tpu.fleet import Fleet, FleetConfig, LeastLoadedRouter
+    from cloud_tpu.monitoring import tracing
+    from cloud_tpu.monitoring.report import TraceReport
     from cloud_tpu.serving import ServeConfig, ServingEngine
     from cloud_tpu.utils import faults
 
@@ -596,106 +616,166 @@ def _run_flash_crowd_arm(params, config, *, cost_model: bool,
         prefix_affinity=True,
         cache_alpha=1.0 if cost_model else 0.0,
     )
-    fleet = Fleet(
-        factory, FleetConfig(min_replicas=2, poll_interval_s=0.05),
-        router=router,
-    )
-    fleet.wait_ready(timeout=timeout)
-    # Warm pass outside the fault plan (phase-1 discipline).
-    fleet.submit(crowd[0][0][:4], max_new_tokens=2).result(timeout=timeout)
+    tmpdir = tempfile.mkdtemp(prefix="cloud_tpu_check_fleet_")
+    timeline_path = os.path.join(tmpdir, "timeline.json")
+    crowd_trace_ids = []
+    try:
+        with tracing.collecting():
+            fleet = Fleet(
+                factory,
+                FleetConfig(min_replicas=2, poll_interval_s=0.05),
+                router=router,
+            )
+            fleet.wait_ready(timeout=timeout)
+            # Warm pass outside the fault plan (phase-1 discipline).
+            fleet.submit(crowd[0][0][:4], max_new_tokens=2).result(
+                timeout=timeout
+            )
 
-    # SEED, fully drained before the measurement: crowd prefix onto
-    # replica 0 (cold-fleet ties break to the lowest id, then
-    # affinity), pressure prefix onto replica 1 (submitted while a
-    # crowd request is still in flight on 0, so least-loaded routing
-    # lands it on 1).  After this both arms' routers face the same
-    # state: summaries {0: crowd prefix, 1: pressure prefix}.
-    results = []
+            # SEED, fully drained before the measurement: crowd prefix
+            # onto replica 0 (cold-fleet ties break to the lowest id,
+            # then affinity), pressure prefix onto replica 1 (submitted
+            # while a crowd request is still in flight on 0, so
+            # least-loaded routing lands it on 1).  After this both
+            # arms' routers face the same state: summaries {0: crowd
+            # prefix, 1: pressure prefix}.
+            results = []
 
-    def serve_seed(request):
-        prompt, budget = request
-        results.append(
-            (prompt, budget,
-             fleet.submit(prompt, max_new_tokens=budget)
-             .result(timeout=timeout))
+            def serve_seed(request):
+                prompt, budget = request
+                results.append(
+                    (prompt, budget,
+                     fleet.submit(prompt, max_new_tokens=budget)
+                     .result(timeout=timeout))
+                )
+
+            serve_seed(crowd[0])
+            serve_seed(crowd[1])
+            crowd_future = fleet.submit(crowd[2][0],
+                                        max_new_tokens=crowd[2][1])
+            pressure_future = fleet.submit(pressure[0][0],
+                                           max_new_tokens=pressure[0][1])
+            results.append((crowd[2][0], crowd[2][1],
+                            crowd_future.result(timeout=timeout)))
+            results.append((pressure[0][0], pressure[0][1],
+                            pressure_future.result(timeout=timeout)))
+            serve_seed(pressure[1])
+
+            # The measured traffic: alternating same-tenant BURSTS, all
+            # submitted without waiting (open flood).  The cost model
+            # keeps each tenant on the replica whose summary advertises
+            # its prefix — the two replicas drain their tenants in
+            # parallel, every request a one-chunk hit.  The tie-break
+            # arm's affinity only fires on load-EQUAL ties, which a
+            # burst destroys immediately, so bursts spray by load, the
+            # tenants interleave on both replicas, and every
+            # alternation pays the thrash.  Mid-flood, a chunk dispatch
+            # hangs past the watchdog on whichever replica draws it —
+            # requests in flight there fail over, and the router
+            # re-learns the surviving cache from the LIVE
+            # cached_prefixes summaries.
+            plan = [{"site": "serve.chunk", "mode": "hang",
+                     "hang_s": 0.3, "nth": 12}]
+            rounds = 5
+            per_burst = 4
+            outcomes = []
+            with faults.inject(plan) as active:
+                for r in range(rounds):
+                    lo, hi = 3 + r * per_burst, 3 + (r + 1) * per_burst
+                    for prompt, budget in crowd[lo:hi]:
+                        outcomes.append(
+                            ("crowd", prompt, budget,
+                             fleet.submit(prompt, max_new_tokens=budget))
+                        )
+                    lo, hi = 2 + r * per_burst, 2 + (r + 1) * per_burst
+                    for prompt, budget in pressure[lo:hi]:
+                        outcomes.append(
+                            ("pressure", prompt, budget,
+                             fleet.submit(prompt, max_new_tokens=budget))
+                        )
+                crowd_ttfts = []
+                for kind, prompt, budget, future in outcomes:
+                    result = future.result(timeout=timeout)
+                    results.append((prompt, budget, result))
+                    if kind == "crowd":
+                        crowd_ttfts.append(result.ttft_seconds)
+                        crowd_trace_ids.append(result.trace_id)
+            # Let supervision converge (phase-1 discipline: the
+            # kill-close must first join the injected hang) before
+            # reading the final state.
+            deadline = time.perf_counter() + timeout
+            while time.perf_counter() < deadline:
+                stats = fleet.stats()
+                health = fleet.health()
+                if (stats["restarts"] >= 1
+                        and health["ready_replicas"] == 2):
+                    break
+                time.sleep(0.05)
+            health = fleet.health()
+            stats = fleet.stats()
+            hit_tokens = sum(
+                int(h.get("prefix_hit_tokens") or 0)
+                for h in health["replicas"]
+            )
+            dram_demotions = sum(
+                int(h.get("prefix_dram_demotions") or 0)
+                for h in health["replicas"]
+            )
+            # Merged per-replica timeline BEFORE close (the lanes come
+            # from the live replica table) — the artifact the trace
+            # gates below read back through the report CLI's machinery.
+            fleet.dump_timeline(timeline_path)
+            fleet.close()
+        leaked = _fleet_threads()
+
+        mismatches = _parity_mismatches(
+            params, config,
+            [r[0] for r in results], [r[1] for r in results],
+            [r[2] for r in results],
         )
 
-    serve_seed(crowd[0])
-    serve_seed(crowd[1])
-    crowd_future = fleet.submit(crowd[2][0], max_new_tokens=crowd[2][1])
-    pressure_future = fleet.submit(pressure[0][0],
-                                   max_new_tokens=pressure[0][1])
-    results.append((crowd[2][0], crowd[2][1],
-                    crowd_future.result(timeout=timeout)))
-    results.append((pressure[0][0], pressure[0][1],
-                    pressure_future.result(timeout=timeout)))
-    serve_seed(pressure[1])
+        # Trace gates (ISSUE 16): every completed request — the
+        # failed-over ones included — must stitch a full lifecycle
+        # (>=1 fleet/route and a terminal serve/request) under ONE
+        # trace id in the merged timeline, at least one failed-over
+        # trace must stitch, and the rendered report must carry the
+        # TTFT decomposition table.  The arm comparison itself moves to
+        # the trace-derived crowd TTFT p99 (same clock as the raw
+        # ServeResult numbers, but reproducible from the artifact).
+        report = TraceReport.from_file(timeline_path)
+        summary = report.request_summary() or {}
 
-    # The measured traffic: alternating same-tenant BURSTS, all
-    # submitted without waiting (open flood).  The cost model keeps
-    # each tenant on the replica whose summary advertises its prefix —
-    # the two replicas drain their tenants in parallel, every request
-    # a one-chunk hit.  The tie-break arm's affinity only fires on
-    # load-EQUAL ties, which a burst destroys immediately, so bursts
-    # spray by load, the tenants interleave on both replicas, and
-    # every alternation pays the thrash.  Mid-flood, a chunk dispatch
-    # hangs past the watchdog on whichever replica draws it — requests
-    # in flight there fail over, and the router re-learns the
-    # surviving cache from the LIVE cached_prefixes summaries.
-    plan = [{"site": "serve.chunk", "mode": "hang", "hang_s": 0.3,
-             "nth": 12}]
-    rounds = 5
-    per_burst = 4
-    outcomes = []
-    with faults.inject(plan) as active:
-        for r in range(rounds):
-            lo, hi = 3 + r * per_burst, 3 + (r + 1) * per_burst
-            for prompt, budget in crowd[lo:hi]:
-                outcomes.append(
-                    ("crowd", prompt, budget,
-                     fleet.submit(prompt, max_new_tokens=budget))
-                )
-            lo, hi = 2 + r * per_burst, 2 + (r + 1) * per_burst
-            for prompt, budget in pressure[lo:hi]:
-                outcomes.append(
-                    ("pressure", prompt, budget,
-                     fleet.submit(prompt, max_new_tokens=budget))
-                )
-        crowd_ttfts = []
-        for kind, prompt, budget, future in outcomes:
-            result = future.result(timeout=timeout)
-            results.append((prompt, budget, result))
-            if kind == "crowd":
-                crowd_ttfts.append(result.ttft_seconds)
-    # Let supervision converge (phase-1 discipline: the kill-close must
-    # first join the injected hang) before reading the final state.
-    deadline = time.perf_counter() + timeout
-    while time.perf_counter() < deadline:
-        stats = fleet.stats()
-        health = fleet.health()
-        if stats["restarts"] >= 1 and health["ready_replicas"] == 2:
-            break
-        time.sleep(0.05)
-    health = fleet.health()
-    stats = fleet.stats()
-    hit_tokens = sum(
-        int(h.get("prefix_hit_tokens") or 0) for h in health["replicas"]
-    )
-    dram_demotions = sum(
-        int(h.get("prefix_dram_demotions") or 0)
-        for h in health["replicas"]
-    )
-    fleet.close()
-    leaked = _fleet_threads()
+        def stitched(trace_id):
+            row = summary.get(trace_id or "")
+            return bool(row and row["complete"] and row["routes"] >= 1)
 
-    mismatches = _parity_mismatches(
-        params, config,
-        [r[0] for r in results], [r[1] for r in results],
-        [r[2] for r in results],
-    )
+        trace_complete = all(
+            stitched(r[2].trace_id) for r in results
+        )
+        failover_stitched = any(
+            stitched(r[2].trace_id)
+            and summary[r[2].trace_id]["failovers"] >= 1
+            for r in results
+        )
+        crowd_rows = {
+            tid: summary[tid] for tid in crowd_trace_ids
+            if tid in summary
+        }
+        decomposition = report.ttft_decomposition(crowd_rows)
+        crowd_ttft_p99_traced = (
+            decomposition["ttft_p99_s"] if decomposition else None
+        )
+        decomposition_rendered = "TTFT decomposition" in report.render()
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
     return {
         "cost_model": cost_model,
         "crowd_ttfts": sorted(crowd_ttfts),
+        "crowd_ttft_p99_traced": crowd_ttft_p99_traced,
+        "trace_complete": trace_complete,
+        "failover_stitched": failover_stitched,
+        "decomposition_rendered": decomposition_rendered,
+        "traced_requests": len(summary),
         "completed": len(results),
         "mismatches": mismatches,
         "hit_tokens": hit_tokens,
@@ -708,11 +788,13 @@ def _run_flash_crowd_arm(params, config, *, cost_model: bool,
 
 
 def check_flash_crowd(timeout: float) -> dict:
-    """Phase 4 (ISSUE 15): cache-aware cost-model routing must beat the
-    tie-break-only affinity on crowd TTFT p99 under the SAME
-    shared-system-prompt flash crowd, background eviction pressure, and
-    mid-run replica kill — while every request keeps greedy parity and
-    nothing leaks."""
+    """Phase 4 (ISSUE 15 + 16): cache-aware cost-model routing must
+    beat the tie-break-only affinity on TRACE-DERIVED crowd TTFT p99
+    under the SAME shared-system-prompt flash crowd, background
+    eviction pressure, and mid-run replica kill — while every request
+    keeps greedy parity, every completed request in BOTH arms stitches
+    a full traced lifecycle (failed-over ones included), the rendered
+    report carries the TTFT decomposition table, and nothing leaks."""
     import jax
     import jax.numpy as jnp
 
@@ -727,8 +809,13 @@ def check_flash_crowd(timeout: float) -> dict:
                                     timeout=timeout)
     cost = _run_flash_crowd_arm(params, config, cost_model=True,
                                 timeout=timeout)
-    tiebreak_p99 = _p99(tiebreak["crowd_ttfts"])
-    cost_p99 = _p99(cost["crowd_ttfts"])
+    # The arm comparison reads the TRACE-DERIVED p99 (reproducible from
+    # the dumped timeline artifact); the raw ServeResult percentiles
+    # stay in the row as the cross-check.
+    tiebreak_p99 = tiebreak["crowd_ttft_p99_traced"] or _p99(
+        tiebreak["crowd_ttfts"]
+    )
+    cost_p99 = cost["crowd_ttft_p99_traced"] or _p99(cost["crowd_ttfts"])
     ok = (
         cost_p99 < tiebreak_p99
         and cost["hit_tokens"] > tiebreak["hit_tokens"]
@@ -741,6 +828,19 @@ def check_flash_crowd(timeout: float) -> dict:
         and cost["restarts"] >= 1
         and tiebreak["faults_fired"] == {"serve.chunk": 1}
         and cost["faults_fired"] == {"serve.chunk": 1}
+        # Trace completeness (ISSUE 16) in BOTH chaos arms: every
+        # completed request stitched end-to-end, at least one
+        # failed-over trace among them, decomposition table rendered,
+        # and the traced p99s actually existed (None would silently
+        # fall back to the raw compare above).
+        and tiebreak["trace_complete"]
+        and cost["trace_complete"]
+        and tiebreak["failover_stitched"]
+        and cost["failover_stitched"]
+        and tiebreak["decomposition_rendered"]
+        and cost["decomposition_rendered"]
+        and tiebreak["crowd_ttft_p99_traced"] is not None
+        and cost["crowd_ttft_p99_traced"] is not None
         and not tiebreak["leaked_threads"]
         and not cost["leaked_threads"]
     )
@@ -749,6 +849,14 @@ def check_flash_crowd(timeout: float) -> dict:
         "ok": ok,
         "tiebreak_crowd_ttft_p99": round(tiebreak_p99, 4),
         "cost_model_crowd_ttft_p99": round(cost_p99, 4),
+        "trace_complete": {"tiebreak": tiebreak["trace_complete"],
+                           "cost_model": cost["trace_complete"]},
+        "failover_stitched": {
+            "tiebreak": tiebreak["failover_stitched"],
+            "cost_model": cost["failover_stitched"],
+        },
+        "traced_requests": {"tiebreak": tiebreak["traced_requests"],
+                            "cost_model": cost["traced_requests"]},
         "hit_tokens": {"tiebreak": tiebreak["hit_tokens"],
                        "cost_model": cost["hit_tokens"]},
         "dram_demotions": {"tiebreak": tiebreak["dram_demotions"],
@@ -800,6 +908,7 @@ def main(argv=None) -> int:
             < phases[3]["tiebreak_crowd_ttft_p99"]
         ),
         "flash_crowd_hit_tokens": phases[3]["hit_tokens"],
+        "flash_crowd_trace_complete": phases[3]["trace_complete"],
         "leaked_threads": (
             phases[0]["leaked_threads"] + phases[1]["leaked_threads"]
             + phases[2]["leaked_threads"] + phases[3]["leaked_threads"]

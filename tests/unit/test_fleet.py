@@ -817,6 +817,133 @@ class TestFleetReport:
         assert "fleet (routing" not in report.render()
 
 
+class TracedFakeEngine(FakeEngine):
+    """FakeEngine whose ``submit`` takes the ``trace`` kwarg and emits
+    the terminal ``serve/request`` span on completion, like a real
+    traced engine — the duck-typed seam the replica's signature probe
+    flips on."""
+
+    def __init__(self, name, **kwargs):
+        super().__init__(name, **kwargs)
+        self.traces = []
+
+    def submit(self, prompt, *, max_new_tokens=None, deadline_s=None,
+               trace=None):
+        from cloud_tpu.monitoring import tracing
+
+        self.traces.append(trace)
+        future = super().submit(
+            prompt, max_new_tokens=max_new_tokens, deadline_s=deadline_s,
+        )
+        if self.auto and trace is not None:
+            now = time.perf_counter()
+            tracing.record_span(
+                "serve/request", now - 0.002, now,
+                trace_id=trace.trace_id, ttft_s=0.001, tokens=2,
+            )
+        return future
+
+
+class TestFleetTracing:
+    """ISSUE 16: trace-context propagation through routing and
+    failover, the signature probe, the ``traced`` stats key, and the
+    merged fleet timeline."""
+
+    def test_trace_survives_failover_and_stitches_one_lifecycle(
+            self, tmp_path):
+        from cloud_tpu.monitoring import tracing
+        from cloud_tpu.monitoring.report import TraceReport
+
+        # Replica 0 is always full: the least-loaded tie routes there
+        # first (lowest id), fails over, and replica 1 completes.
+        full = TracedFakeEngine("full", max_queue=0)
+        ok = TracedFakeEngine("ok")
+        factory = _Factory([full, ok])
+        path = str(tmp_path / "fleet.json")
+        with tracing.collecting():
+            fleet = Fleet(factory, _quiet_config(min_replicas=2))
+            try:
+                result = fleet.submit(
+                    np.asarray([1, 2], np.int32)
+                ).result(timeout=30)
+                assert result["served_by"] == "ok"
+                # Both replicas advertise the probe, and the SAME
+                # context object hopped with the request.
+                assert all(r.accepts_trace for r in fleet.replicas())
+                assert ok.traces and ok.traces[0] is not None
+                stats = fleet.stats()
+                assert stats["traced"] == 1
+                assert stats["failovers"] == 1
+                assert fleet.dump_timeline(path) == path
+            finally:
+                fleet.close()
+
+        report = TraceReport.from_file(path)
+        summary = report.request_summary()
+        assert summary is not None and len(summary) == 1
+        ((trace_id, row),) = summary.items()
+        assert trace_id == ok.traces[0].trace_id
+        # One stitched lifecycle: the failed attempt, the re-route, and
+        # the terminal span all share the request's single identity.
+        assert row["complete"]
+        assert row["routes"] == 1  # only the ACCEPTED attempt routes
+        assert row["failovers"] == 1
+        assert row["ttft_s"] == pytest.approx(0.001, abs=1e-3)
+        assert report.render_trace(trace_id) is not None
+
+    def test_legacy_engine_without_trace_kwarg_still_routes_traced(self):
+        from cloud_tpu.monitoring import tracing
+
+        # Plain FakeEngine.submit has no trace kwarg (and no **kwargs):
+        # the probe must gate forwarding so pre-trace engines keep
+        # working, while the fleet's own spans still stamp the id.
+        engine = FakeEngine("legacy")
+        factory = _Factory([engine])
+        with tracing.collecting() as collector:
+            fleet = Fleet(factory, _quiet_config())
+            try:
+                assert not fleet.replicas()[0].accepts_trace
+                result = fleet.submit(
+                    np.asarray([3], np.int32)
+                ).result(timeout=30)
+                assert result["served_by"] == "legacy"
+                assert fleet.stats()["traced"] == 1
+            finally:
+                fleet.close()
+        routes = [
+            e for e in collector.events() if e["name"] == "fleet/route"
+        ]
+        assert routes and "trace_id" in routes[0]["args"]
+        assert isinstance(routes[0]["args"]["queue_s"], float)
+
+    def test_tracing_off_is_inert_and_stats_schema_pinned(self):
+        from cloud_tpu.monitoring import tracing
+
+        assert not tracing.enabled()
+        engine = TracedFakeEngine("quiet")
+        fleet = Fleet(_Factory([engine]), _quiet_config())
+        try:
+            fleet.submit(np.asarray([4], np.int32)).result(timeout=30)
+            # Schema pin: the key exists and stays zero — no context
+            # was minted, and none reached the engine.
+            assert fleet.stats()["traced"] == 0
+            assert engine.traces == [None]
+        finally:
+            fleet.close()
+
+    def test_dump_timeline_without_tracing_is_empty_but_valid(
+            self, tmp_path):
+        import json
+
+        fleet = Fleet(_Factory([FakeEngine("a")]), _quiet_config())
+        try:
+            path = fleet.dump_timeline(str(tmp_path / "off.json"))
+        finally:
+            fleet.close()
+        doc = json.loads(open(path).read())
+        assert [e for e in doc["traceEvents"] if e["ph"] == "X"] == []
+
+
 @pytest.fixture(scope="module")
 def model():
     import jax

@@ -556,3 +556,21 @@ class TestWindowedRate:
         # Restarted: an immediate second flush is the empty-window case.
         rate.flush(5.0)
         assert self._gauge("wr/partial") == pytest.approx(0.5)  # unchanged
+
+
+def test_check_spans_script():
+    """The span-name contract is enforceable: every span recorded in
+    cloud_tpu/ + bench.py appears in docs/observability.md's
+    instrumentation table and vice versa (ISSUE 16 satellite).  Pure
+    static grep — runs in milliseconds, so it rides tier 1 un-marked."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "check_spans.py")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, (proc.stdout or "") + (proc.stderr or "")
+    assert "in sync" in proc.stdout

@@ -424,6 +424,9 @@ class TestHealth:
         assert stats["class_completed"] == zeros
         assert stats["class_shed"] == zeros
         assert stats["class_backlog"] == zeros
+        # ISSUE 16: the traced-request counter is schema in both
+        # schedulers too — zero whenever requests carry no context.
+        assert stats["traced"] == 0
 
     def test_continuous_health_carries_load_signal(self, model):
         config, params = model
@@ -486,6 +489,94 @@ class TestObservability:
         assert snap["counters"].get("serve/batches", 0) >= 1
         assert "serve/batch_occupancy" in snap["gauges"]
         assert "serve/latency_seconds" in snap["distributions"]
+
+    def test_traced_request_emits_terminal_span_on_fifo(self, model):
+        """ISSUE 16: a request submitted WITH a trace context gets the
+        terminal ``serve/request`` span (trace_id + ttft_s, no phantom
+        QoS priority) even on the FIFO path, its result carries the id,
+        and every lifecycle span it touched stamps the same id."""
+        from cloud_tpu.monitoring import tracing
+
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(1, 2),
+            flush_deadline_s=0.0, scheduler="batch",
+        )
+        with tracing.collecting() as collector:
+            with ServingEngine(params, config, serve) as engine:
+                ctx = tracing.new_trace_context()
+                result = engine.submit(
+                    np.asarray([1, 2, 3], np.int32), trace=ctx
+                ).result(timeout=120)
+                assert engine.stats()["traced"] == 1
+        assert result.trace_id == ctx.trace_id
+        events = collector.events()
+        terminals = [e for e in events if e["name"] == "serve/request"]
+        assert len(terminals) == 1
+        args = terminals[0]["args"]
+        assert args["trace_id"] == ctx.trace_id
+        assert isinstance(args["ttft_s"], float) and args["ttft_s"] > 0
+        assert args["tokens"] == 2
+        assert "priority" not in args  # FIFO: no phantom QoS class
+        waits = [e for e in events if e["name"] == "serve/queue_wait"]
+        assert any(
+            (e["args"] or {}).get("trace_id") == ctx.trace_id
+            for e in waits
+        )
+
+    def test_traced_request_rides_the_chunk_slot_map(self, model):
+        """Continuous scheduler: shared decode dispatches serve many
+        slots, so the chunk span carries a slot -> trace_id map instead
+        of a single id, and the terminal span still stitches."""
+        from cloud_tpu.monitoring import tracing
+
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(1, 2),
+            chunk_tokens=1,
+        )
+        with tracing.collecting() as collector:
+            with ServingEngine(params, config, serve) as engine:
+                ctx = tracing.new_trace_context()
+                result = engine.submit(
+                    np.asarray([5, 6], np.int32), trace=ctx
+                ).result(timeout=120)
+        assert result.trace_id == ctx.trace_id
+        events = collector.events()
+        chunks = [e for e in events if e["name"] == "serve/chunk"]
+        assert any(
+            ctx.trace_id in ((e["args"] or {}).get("traces") or {}).values()
+            for e in chunks
+        )
+        terminals = [e for e in events if e["name"] == "serve/request"]
+        assert [e["args"]["trace_id"] for e in terminals] == [ctx.trace_id]
+
+    def test_untraced_span_set_is_unchanged(self, model):
+        """The default-off pin: with tracing active but requests
+        submitted WITHOUT a context, the emitted span set is what it
+        was before trace propagation existed — no terminal span on the
+        FIFO path, no trace_id attribute, no slot map — so enabling the
+        collector alone never changes a timeline's shape."""
+        from cloud_tpu.monitoring import tracing
+
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=2, prompt_buckets=(8,), batch_buckets=(1, 2),
+            flush_deadline_s=0.0, scheduler="batch",
+        )
+        with tracing.collecting() as collector:
+            with ServingEngine(params, config, serve) as engine:
+                result = engine.submit(
+                    np.asarray([1, 2, 3], np.int32)
+                ).result(timeout=120)
+                assert engine.stats()["traced"] == 0
+        assert result.trace_id is None
+        events = collector.events()
+        assert all("serve/request" != e["name"] for e in events)
+        for event in events:
+            args = event.get("args") or {}
+            assert "trace_id" not in args, event["name"]
+            assert "traces" not in args, event["name"]
 
 
 class TestContinuous:

@@ -512,6 +512,92 @@ class TestReportPrefixSection:
         assert "prefix cache:" not in other.render()
 
 
+class TestDemoteBurst:
+    """ISSUE 16 satellite: a demotion burst shares ONE supervised
+    worker thread instead of paying a fresh watchdog dispatch thread
+    per evicted block, with ``_supervised``'s full timeout contract
+    applied per call."""
+
+    class _StubEngine:
+        """The slice of ServingEngine the burst dispatcher touches."""
+
+        def __init__(self, timeout):
+            import threading
+
+            from cloud_tpu.serving import ServeConfig
+
+            self.serve_config = ServeConfig(dispatch_timeout_s=timeout)
+            self._demote_dispatcher = None
+            self._last_dispatch_ts = None
+            self._orphan_dispatches = []
+            self._unhealthy_reason = None
+            self._stats = {"watchdog_timeouts": 0}
+            self._stats_lock = threading.Lock()
+
+    def test_burst_runs_every_call_on_one_worker_thread(self):
+        import threading
+
+        from cloud_tpu.serving.engine import ServingEngine
+
+        engine = self._StubEngine(timeout=5.0)
+        workers = []
+        with ServingEngine._demote_burst(engine):
+            burst = engine._demote_dispatcher
+            assert burst is not None
+            for i in range(5):
+                value = burst.call(
+                    "serve/prefix_demote",
+                    lambda i=i: (workers.append(
+                        threading.current_thread()
+                    ), i)[1],
+                )
+                assert value == i
+        # The thread-count pin: five demotions, ONE dispatch thread —
+        # and never the caller's own.
+        assert len({t.ident for t in workers}) == 1
+        assert workers[0] is not threading.current_thread()
+        assert not workers[0].is_alive()  # shutdown joined it
+        assert engine._demote_dispatcher is None  # scope cleared
+        assert engine._orphan_dispatches == []
+
+    def test_burst_timeout_latches_unhealthy_and_skips_the_rest(self):
+        import threading
+
+        from cloud_tpu.serving.engine import (
+            DispatchTimeoutError,
+            ServingEngine,
+        )
+
+        engine = self._StubEngine(timeout=0.05)
+        release = threading.Event()
+        with ServingEngine._demote_burst(engine):
+            burst = engine._demote_dispatcher
+            with pytest.raises(DispatchTimeoutError, match="exceeded"):
+                burst.call("serve/prefix_demote", release.wait)
+            # The wedged worker is orphan-tracked, the engine latched
+            # unhealthy, and queueing behind the hang is refused.
+            assert engine._unhealthy_reason is not None
+            assert engine._stats["watchdog_timeouts"] == 1
+            assert len(engine._orphan_dispatches) == 1
+            with pytest.raises(DispatchTimeoutError, match="skipped"):
+                burst.call("serve/prefix_demote", lambda: 1)
+        release.set()  # unwedge the daemon worker
+
+    def test_burst_is_a_noop_without_watchdog_or_when_nested(self):
+        from cloud_tpu.serving.engine import ServingEngine
+
+        # dispatch_timeout_s=None runs demotions inline anyway.
+        engine = self._StubEngine(timeout=None)
+        with ServingEngine._demote_burst(engine):
+            assert engine._demote_dispatcher is None
+        # Nested bursts keep the OUTER dispatcher (still one thread).
+        engine = self._StubEngine(timeout=5.0)
+        with ServingEngine._demote_burst(engine):
+            outer = engine._demote_dispatcher
+            with ServingEngine._demote_burst(engine):
+                assert engine._demote_dispatcher is outer
+
+
 class TestServeConfigKnobs:
     def test_validation(self):
         from cloud_tpu.serving import ServeConfig

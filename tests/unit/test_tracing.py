@@ -382,3 +382,257 @@ class TestXprofMirroring:
             with tracing.span("quiet2"):
                 pass
         assert entered == [("enter", "mirrored"), ("exit", "mirrored")]
+
+
+class TestTraceContext:
+    """The propagatable request identity (ISSUE 16) and its default-off
+    contract: no collector, no context — the field rides inert."""
+
+    def test_disabled_mints_nothing(self):
+        assert tracing.new_trace_context() is None
+
+    def test_enabled_mints_unique_process_scoped_ids(self):
+        with tracing.collecting():
+            a = tracing.new_trace_context()
+            b = tracing.new_trace_context(parent_id=7)
+        assert a is not None and b is not None
+        assert a.trace_id != b.trace_id
+        # Process-scoped prefix: merged multi-process timelines can
+        # never collide two requests onto one id.
+        assert a.trace_id.startswith(f"{os.getpid():x}-")
+        assert a.parent_id == 0
+        assert b.parent_id == 7
+
+    def test_context_is_a_frozen_identity(self):
+        import dataclasses
+
+        with tracing.collecting():
+            ctx = tracing.new_trace_context()
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            ctx.trace_id = "rewritten"
+
+
+class TestLanes:
+    """Timeline lanes: synthetic pid rows so fleet replicas sharing one
+    process (and one collector) render as separate Perfetto lanes."""
+
+    def test_register_lane_allocates_labelled_rows_above_pid_range(self):
+        a = tracing.register_lane("replica a")
+        b = tracing.register_lane("replica b")
+        assert a != b
+        assert min(a, b) >= tracing._LANE_BASE  # never collides with an OS pid
+        assert tracing.lane_label(a) == "replica a"
+        assert tracing.lane_label(os.getpid()) is None
+
+    def test_thread_lane_stamps_event_pid(self):
+        lane = tracing.register_lane("laned replica")
+        with tracing.collecting() as col:
+            with tracing.span("unlaned"):
+                pass
+            tracing.set_thread_lane(lane)
+            try:
+                with tracing.span("laned"):
+                    pass
+                now = time.perf_counter()
+                tracing.record_span("laned_record", now - 0.001, now)
+            finally:
+                tracing.set_thread_lane(None)  # thread-local: reset for peers
+            with tracing.span("after_reset"):
+                pass
+        events = {e["name"]: e for e in col.events()}
+        assert events["unlaned"]["pid"] == os.getpid()
+        assert events["laned"]["pid"] == lane
+        assert events["laned_record"]["pid"] == lane
+        assert events["after_reset"]["pid"] == os.getpid()
+
+
+class TestSnapshotAndMerge:
+    """snapshot() + merge_timelines(): the Fleet.dump_timeline building
+    blocks — one consistent cut per collector, epoch-normalized onto a
+    single wall with labelled pid lanes."""
+
+    def test_snapshot_is_one_consistent_cut(self):
+        with tracing.collecting(capacity=2) as col:
+            for _ in range(3):
+                with tracing.span("tick"):
+                    pass
+            snap = col.snapshot()
+            assert set(snap) == {"epoch", "events", "evicted"}
+            assert snap["epoch"] == col.epoch
+            assert len(snap["events"]) == 2
+            assert snap["evicted"] == 1
+            snap["events"].clear()  # a copy, not a view of the buffer
+            assert len(col.events()) == 2
+
+    def test_merge_normalizes_epochs_and_labels_lanes(self, tmp_path):
+        event = {"name": "w", "ph": "X", "ts": 1000.0, "dur": 5.0,
+                 "tid": 1, "args": {}}
+        sources = [
+            {"label": "fleet", "epoch": 100.0,
+             "events": [dict(event, pid=111)], "pid": 111},
+            # Born 0.5s later on its own monotonic clock; 3 events
+            # already evicted from its ring buffer.
+            {"label": "replica 0", "epoch": 100.5,
+             "events": [dict(event, pid=222)], "pid": 222, "evicted": 3},
+        ]
+        path = tracing.merge_timelines(
+            sources, str(tmp_path / "merged.json")
+        )
+        assert path == str(tmp_path / "merged.json")
+        doc = json.loads((tmp_path / "merged.json").read_text())
+        spans = {e["pid"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert spans[111]["ts"] == pytest.approx(1000.0)
+        # The later epoch shifts by the offset against the EARLIEST one.
+        assert spans[222]["ts"] == pytest.approx(1000.0 + 0.5e6)
+        lanes = {
+            m["pid"]: m["args"]["name"] for m in doc["traceEvents"]
+            if m["ph"] == "M" and m["name"] == "process_name"
+        }
+        assert lanes == {111: "fleet", 222: "replica 0"}
+        assert doc["otherData"]["evicted_events"] == 3
+
+
+class TestRequestStitching:
+    """report.py's trace-id machinery (ISSUE 16): per-request lifecycle
+    stitching, the fleet TTFT decomposition, the --trace drill-down,
+    and graceful degradation on partial/untraced timelines."""
+
+    def _traced_dump(self, tmp_path):
+        """A hand-built two-request timeline with known milestone gaps.
+
+        t1 lives a full fleet lifecycle (route -> engine queue ->
+        prefill -> shared chunk + verify -> terminal).  t2 only ever
+        appears in the shared chunk's slot map — the shape left behind
+        when the ring buffer evicted its early spans.
+        """
+        with tracing.collecting():
+            base = time.perf_counter()
+            tracing.record_span("fleet/route", base, base + 0.010,
+                                trace_id="t1", replica=0, attempt=1,
+                                queue_s=0.050)
+            tracing.record_span("serve/queue_wait", base + 0.010,
+                                base + 0.030, trace_id="t1")
+            tracing.record_span("serve/prefill", base + 0.030,
+                                base + 0.050, trace_id="t1")
+            tracing.record_span("serve/chunk", base + 0.050, base + 0.060,
+                                traces={"0": "t1", "1": "t2"})
+            tracing.record_span("serve/verify", base + 0.060, base + 0.062,
+                                traces={"0": "t1"}, accepted=3)
+            tracing.record_span("serve/request", base, base + 0.080,
+                                trace_id="t1", ttft_s=0.070, tokens=4)
+            return tracing.dump_timeline(str(tmp_path / "traced.json"))
+
+    def test_request_summary_stitches_full_and_partial_rows(self, tmp_path):
+        report = report_lib.TraceReport.from_file(self._traced_dump(tmp_path))
+        summary = report.request_summary()
+        assert set(summary) == {"t1", "t2"}
+        t1 = summary["t1"]
+        assert t1["complete"] and t1["routes"] == 1 and t1["failovers"] == 0
+        assert t1["queue_s"] == pytest.approx(0.050)
+        assert t1["route_s"] == pytest.approx(0.010, abs=1e-4)
+        assert t1["engine_queue_s"] == pytest.approx(0.020, abs=1e-4)
+        assert t1["prefill_s"] == pytest.approx(0.020, abs=1e-4)
+        assert t1["swapin_s"] == 0.0
+        assert t1["chunks"] == 1
+        assert t1["spec_accepted"] == 3  # batch-level verify credit
+        assert t1["ttft_s"] == pytest.approx(0.070)
+        # fleet TTFT = fleet queue + routing + engine TTFT.
+        assert t1["fleet_ttft_s"] == pytest.approx(0.130, abs=1e-3)
+        assert t1["latency_s"] == pytest.approx(0.080, abs=1e-4)
+        assert t1["tokens"] == 4 and not t1["shed"]
+        # t2 rode one shared chunk and nothing else survived: the row
+        # degrades instead of crashing or vanishing.
+        t2 = summary["t2"]
+        assert not t2["complete"] and t2["chunks"] == 1
+        assert t2["routes"] == 0 and t2["queue_s"] is None
+        assert t2["ttft_s"] is None
+
+    def test_ttft_decomposition_shares(self, tmp_path):
+        report = report_lib.TraceReport.from_file(self._traced_dump(tmp_path))
+        decomposition = report.ttft_decomposition()
+        # Only t1 has a terminal span; t2 cannot decompose.
+        assert decomposition["requests"] == 1
+        assert decomposition["ttft_p50_s"] == pytest.approx(0.130, abs=1e-3)
+        assert decomposition["ttft_p99_s"] == pytest.approx(0.130, abs=1e-3)
+        shares = decomposition["shares"]
+        assert set(shares) == set(report_lib.TraceReport.TTFT_COMPONENTS)
+        total = 0.130
+        assert shares["queue"]["p50"] == pytest.approx(0.070 / total, abs=1e-2)
+        assert shares["route"]["p50"] == pytest.approx(0.010 / total, abs=1e-2)
+        assert shares["swapin"]["p50"] == 0.0
+        assert shares["prefill"]["p50"] == pytest.approx(
+            0.020 / total, abs=1e-2
+        )
+        # first_decode is the remainder after the attributable phases.
+        assert shares["first_decode"]["p50"] == pytest.approx(
+            0.030 / total, abs=1e-2
+        )
+
+    def test_render_includes_traced_sections(self, tmp_path):
+        rendered = report_lib.TraceReport.from_file(
+            self._traced_dump(tmp_path)
+        ).render()
+        assert "traced requests: 2 · 1 complete" in rendered
+        assert "TTFT decomposition" in rendered
+        assert "first_decode" in rendered
+
+    def test_render_trace_and_cli_drilldown(self, tmp_path, capsys):
+        path = self._traced_dump(tmp_path)
+        rendered = report_lib.TraceReport.from_file(path).render_trace("t1")
+        assert "trace t1: 6 span(s)" in rendered
+        assert "fleet/route" in rendered and "serve/request" in rendered
+        assert "routes 1" in rendered and "4 tokens" in rendered
+        assert "3 spec-accepted tokens" in rendered
+        assert report_lib.main([path, "--trace", "t1"]) == 0
+        assert "fleet/route" in capsys.readouterr().out
+        assert report_lib.main([path, "--trace", "zzz"]) == 2
+        assert "not found" in capsys.readouterr().err
+
+    def test_untraced_timeline_degrades_to_none(self, tmp_path):
+        with tracing.collecting():
+            with tracing.span("serve/prefill"):
+                pass
+            path = tracing.dump_timeline(str(tmp_path / "plain.json"))
+        report = report_lib.TraceReport.from_file(path)
+        assert report.request_summary() is None
+        assert report.ttft_decomposition() is None
+        assert report.render_trace("t1") is None
+        rendered = report.render()
+        assert "traced requests" not in rendered
+        assert "TTFT decomposition" not in rendered
+
+    def test_untraced_terminal_span_is_not_a_qos_class(self, tmp_path):
+        # A traced FIFO engine emits serve/request WITHOUT a priority
+        # attribute; it must never surface as a phantom QoS class.
+        with tracing.collecting():
+            now = time.perf_counter()
+            tracing.record_span("serve/request", now - 0.01, now,
+                                trace_id="t1", ttft_s=0.005, tokens=2)
+            path = tracing.dump_timeline(str(tmp_path / "fifo.json"))
+        report = report_lib.TraceReport.from_file(path)
+        assert report.qos_summary() is None
+        assert "QoS classes" not in report.render()
+
+    def test_evicted_early_spans_still_stitch_the_terminal(self, tmp_path):
+        # Ring-buffer churn drops t1's route span; the summary row
+        # degrades (routes 0, queue None) but stays complete, and the
+        # per-name aggregates remain exact (satellite: eviction
+        # coverage).
+        with tracing.collecting(capacity=3) as col:
+            base = time.perf_counter()
+            tracing.record_span("fleet/route", base, base + 0.010,
+                                trace_id="t1", queue_s=0.050)
+            for _ in range(40):
+                with tracing.span("churn"):
+                    pass
+            tracing.record_span("serve/request", base, base + 0.080,
+                                trace_id="t1", ttft_s=0.070, tokens=4)
+            assert col.evicted >= 1
+            assert col.aggregates()["churn"]["count"] == 40
+            assert col.aggregates()["fleet/route"]["count"] == 1
+            path = tracing.dump_timeline(str(tmp_path / "evicted.json"))
+        summary = report_lib.TraceReport.from_file(path).request_summary()
+        row = summary["t1"]
+        assert row["complete"] and row["ttft_s"] == pytest.approx(0.070)
+        assert row["routes"] == 0 and row["queue_s"] is None
+        assert row["fleet_ttft_s"] == pytest.approx(0.070)  # nothing to add
