@@ -1238,6 +1238,116 @@ def _measure_serving_tp(extras):
         )
 
 
+def _measure_serving_decode_kernel(extras):
+    """Paged decode-kernel probe: the churn workload through an
+    ``decode_kernel="xla"`` engine (today's copy-based path) and a
+    kernel-armed engine — ``"pallas"`` on a TPU backend, ``"auto"``
+    elsewhere (the block-table paged path with the jnp reference doing
+    the math, so the no-copy prefix plumbing is still what's measured).
+    Emits ``serve_kernel_tokens_per_sec``,
+    ``serve_kernel_vs_xla_speedup``, and per-arm TTFT/TPOT percentiles,
+    parity-gated like ``serving_tp``/``serving_spec``: a token mismatch
+    between the arms zeroes the rates rather than publishing a speedup
+    for wrong tokens.
+    """
+    import jax
+
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils.benchmarking import decode_setup
+
+    import numpy as np
+
+    cfg, params, _, _ = decode_setup(
+        batch_size=SERVE_MAX_BATCH, prompt_len=SERVE_PROMPT_BUCKET
+    )
+    kernel_mode = (
+        "pallas" if jax.default_backend() == "tpu" else "auto"
+    )
+    rng = np.random.default_rng(6)
+    lengths = rng.integers(
+        8, SERVE_PROMPT_BUCKET + 1, SERVE_CHURN_REQUESTS
+    )
+    budgets = rng.integers(
+        SERVE_NEW_TOKENS // 4, SERVE_NEW_TOKENS + 1, SERVE_CHURN_REQUESTS
+    )
+    prompts = [
+        rng.integers(1, cfg.vocab_size, n).astype(np.int32) for n in lengths
+    ]
+
+    def churn(decode_kernel):
+        serve = ServeConfig(
+            max_new_tokens=SERVE_NEW_TOKENS,
+            prompt_buckets=(SERVE_PROMPT_BUCKET // 2, SERVE_PROMPT_BUCKET),
+            num_slots=SERVE_MAX_BATCH,
+            chunk_tokens=SERVE_CHURN_CHUNK,
+            warmup=True,
+            decode_kernel=decode_kernel,
+        )
+        with ServingEngine(params, cfg, serve, mesh=None) as engine:
+            engine.wait_ready()
+            engine.submit(prompts[0]).result()  # absorb first dispatch
+            start = time.perf_counter()
+            futures = []
+            for i, prompt in enumerate(prompts):
+                futures.append(
+                    engine.submit(prompt, max_new_tokens=int(budgets[i]))
+                )
+                if (i + 1) % (SERVE_MAX_BATCH // 2) == 0:
+                    time.sleep(0.02)  # staggered waves, not one burst
+            results = [f.result() for f in futures]
+            wall = time.perf_counter() - start
+        tokens = sum(r.num_generated for r in results)
+        return results, tokens / wall if wall else 0.0
+
+    xla_results, xla_rate = churn("xla")
+    kernel_results, kernel_rate = churn(kernel_mode)
+
+    mismatches = sum(
+        1 for kr, xr in zip(kernel_results, xla_results)
+        if not np.array_equal(kr.tokens, xr.tokens)
+        or kr.num_generated != xr.num_generated
+    )
+    ok = mismatches == 0
+
+    for arm, results in (("kernel", kernel_results), ("xla", xla_results)):
+        ttfts = sorted(r.ttft_seconds for r in results)
+        tpots = sorted(
+            (r.latency_seconds - r.ttft_seconds)
+            / max(r.num_generated - 1, 1)
+            for r in results
+        )
+        extras[f"serve_{arm}_ttft_p50_seconds"] = round(
+            _latency_pct(ttfts, 0.5), 4
+        )
+        extras[f"serve_{arm}_ttft_p99_seconds"] = round(
+            _latency_pct(ttfts, 0.99), 4
+        )
+        extras[f"serve_{arm}_tpot_p50_seconds"] = round(
+            _latency_pct(tpots, 0.5), 5
+        )
+        extras[f"serve_{arm}_tpot_p99_seconds"] = round(
+            _latency_pct(tpots, 0.99), 5
+        )
+    extras["serve_kernel_tokens_per_sec"] = round(
+        kernel_rate if ok else 0.0, 1
+    )
+    extras["serve_kernel_vs_xla_speedup"] = round(
+        kernel_rate / xla_rate if ok and xla_rate else 0.0, 3
+    )
+    extras["serve_kernel_xla_tokens_per_sec"] = round(xla_rate, 1)
+    extras["serve_kernel_parity_mismatches"] = mismatches
+    extras["serve_kernel_config"] = (
+        f"SMALL decode_kernel={kernel_mode} slots{SERVE_MAX_BATCH} "
+        f"chunk{SERVE_CHURN_CHUNK} new<= {SERVE_NEW_TOKENS} "
+        f"n{SERVE_CHURN_REQUESTS} staggered"
+    )
+    if not ok:
+        raise RuntimeError(
+            f"decode-kernel arm failed parity: {mismatches} mismatched "
+            "request(s) vs the xla arm"
+        )
+
+
 def _measure_fleet(extras):
     """Fleet probe: the churn workload (staggered arrivals, mixed prompt
     AND output lengths) through ``cloud_tpu.fleet.Fleet`` fronting
@@ -1603,6 +1713,7 @@ def _child_main() -> int:
         (_measure_serving_prefix_tier, "serving_prefix_tier"),
         (_measure_serving_spec, "serving_spec"),
         (_measure_serving_tp, "serving_tp"),
+        (_measure_serving_decode_kernel, "serving_decode_kernel"),
         (_measure_fleet, "fleet"),
         (_measure_fleet_qps_sweep, "fleet_qps_sweep"),
         (_measure_durability, "durability"),

@@ -199,8 +199,30 @@ def _mlp(layer_params, y, config, rules):
     return layers.mlp_block_apply(layer_params["mlp"], y, rules=rules)
 
 
+def _paged_attended(kind, q, cache_l, cur_len, paged):
+    """Route one attention through ``ops.paged_attention`` (the
+    block-table read-in-place path).  ``paged`` carries the per-layer
+    pool slice, the block table, and the dispatch knobs; KV writes stay
+    in the slot row (suffix positions never overlap pool-backed pages —
+    prefix hits are block-aligned), so only the READ side changes."""
+    from cloud_tpu import ops
+
+    fn = {
+        "decode": ops.paged_decode_attention,
+        "chunk": ops.paged_chunk_attention,
+        "verify": ops.paged_verify_attention,
+    }[kind]
+    return fn(
+        q, cache_l, cur_len,
+        pool_l=paged.get("pool_l"),
+        block_table=paged["block_table"],
+        use_pallas=paged.get("use_pallas"),
+        partitioned=paged.get("partitioned", False),
+    )
+
+
 def _decode_layer(layer_params, x, cache_l, cur_len, config, rules,
-                  write_pos=None):
+                  write_pos=None, paged=None):
     """One block on a single-token slice x [B, 1, D]; writes this step's
     k/v at position cur_len[i] and attends over the whole valid prefix
     (including the just-written position).
@@ -209,7 +231,11 @@ def _decode_layer(layer_params, x, cache_l, cur_len, config, rules,
     entry SUPPRESSES that row's write (drop-mode scatter).  The chunk
     scheduler uses it to keep inactive slots from stomping their frozen
     position — a row mid-way through a chunked prefill holds real KV
-    there (see ``decode_chunk_program``)."""
+    there (see ``decode_chunk_program``).
+
+    ``paged`` (see :func:`_paged_attended`) swaps the attention read for
+    the block-table paged path; ``None`` keeps this function's trace
+    byte-identical to its pre-paged form."""
     b = x.shape[0]
     y = layers.rmsnorm_apply(layer_params["ln1"], x)
     q, k_new, v_new = transformer.qkv_project(
@@ -236,7 +262,11 @@ def _decode_layer(layer_params, x, cache_l, cur_len, config, rules,
         cache_l["v"] = cache_l["v"].at[rows, wp].set(
             v_new[:, 0], mode="drop"
         )
-    attended = _cache_attention(q, cache_l, cur_len + 1)
+    if paged is None:
+        attended = _cache_attention(q, cache_l, cur_len + 1)
+    else:
+        attended = _paged_attended("decode", q, cache_l, cur_len + 1,
+                                   paged)
     att_out = layers.dense_apply(
         layer_params["att"]["out"], attended.reshape(b, 1, -1)
     )
@@ -358,31 +388,48 @@ def _prefill(params, prompt_tokens, prompt_lens, config, s, rules, mesh,
 
 
 def _decode_step(params, cache, token, cur_len, config, rules, mesh,
-                 write_pos=None):
+                 write_pos=None, pool=None, block_table=None,
+                 use_pallas=None):
     """One single-token decode step for every row at once: embed
     ``token`` [B], run the scanned layer stack against the cache (each
     row's k/v written at its ``cur_len``, or ``write_pos`` when given —
     see :func:`_decode_layer`), return the updated cache and the
     next-token logits [B, V].  The shared inner loop of
     :func:`_decode_tokens`, :func:`beam_search`, and
-    :func:`decode_chunk_program`."""
+    :func:`decode_chunk_program`.
+
+    ``block_table`` [B, n_pages] (with the optional prefix ``pool``
+    scanned alongside the cache) routes attention through the paged
+    read-in-place path; ``None`` (the default, and every non-serving
+    caller) keeps the trace byte-identical to the pre-paged program."""
     x = layers.embedding_apply(
         params["embed"], token[:, None], dtype=config.dtype,
         rules=rules, mesh=mesh,
     )
     x = x * math.sqrt(config.dim)
+    paged_base = None
+    if block_table is not None:
+        paged_base = {"block_table": block_table, "use_pallas": use_pallas,
+                      "partitioned": mesh is not None}
 
     def layer_body(x, layer_slice):
-        layer_params, cache_l = layer_slice
+        if pool is None:
+            layer_params, cache_l = layer_slice
+            paged = paged_base
+        else:
+            layer_params, cache_l, pool_l = layer_slice
+            paged = (None if paged_base is None
+                     else dict(paged_base, pool_l=pool_l))
         x, cache_l = _decode_layer(
             layer_params, x, cache_l, cur_len, config, rules,
-            write_pos=write_pos,
+            write_pos=write_pos, paged=paged,
         )
         return x, cache_l
 
-    x, cache = jax.lax.scan(
-        layer_body, x, (params["layers"], cache)
+    xs = (params["layers"], cache) if pool is None else (
+        params["layers"], cache, pool
     )
+    x, cache = jax.lax.scan(layer_body, x, xs)
     logits = _final_logits(params, x, config)[:, 0]
     # Sampling boundary reshard (see _prefill_forward): vocab-sharded
     # logits gather to replicated exactly once per decode step.
@@ -762,6 +809,9 @@ def decode_chunk_program(
     rng: Optional[jax.Array] = None,
     rules: ShardingRules = DEFAULT_RULES,
     mesh=None,
+    pool=None,
+    block_table=None,
+    use_pallas=None,
 ):
     """Advance every active slot by up to ``chunk_size`` tokens.
 
@@ -784,6 +834,11 @@ def decode_chunk_program(
     emission (a prefix per row — slots only ever deactivate mid-chunk,
     reactivation happens between chunks via
     :func:`insert_slot_program`).
+
+    ``block_table`` [num_slots, n_pages] (plus the prefix ``pool``)
+    routes every step's attention through the paged read-in-place path
+    (see :func:`_decode_step`); the defaults keep the trace
+    byte-identical to the pre-paged program.
     """
     if chunk_size < 1:
         raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
@@ -806,7 +861,8 @@ def decode_chunk_program(
         write_pos = jnp.where(active, state["pos"], jnp.int32(s))
         cache, logits = _decode_step(
             params, cache, state["tok"], state["pos"], config, rules, mesh,
-            write_pos=write_pos,
+            write_pos=write_pos, pool=pool, block_table=block_table,
+            use_pallas=use_pallas,
         )
         allow = (
             state["emitted"] >= sample.min_new_tokens if need_min else None
@@ -972,6 +1028,9 @@ def prefill_chunk_program(
     *,
     rules: ShardingRules = DEFAULT_RULES,
     mesh=None,
+    pool=None,
+    block_table=None,
+    use_pallas=None,
 ):
     """Prefill one bounded chunk of a prompt into one live slot row.
 
@@ -991,6 +1050,14 @@ def prefill_chunk_program(
     Returns ``(cache, logits)`` with ``logits`` [1, V] taken at the
     chunk's LAST REAL token; only the final chunk's logits mean
     anything (feed them to :func:`finalize_slot_program`).
+
+    ``block_table`` [num_slots, n_pages] + ``pool`` route the
+    chunk-causal attention through the paged read-in-place path: a
+    prefix hit's pool-backed pages are read directly from the pool
+    (the engine skips ``copy_prefix_program`` entirely), while the
+    chunk's own writes land in the slot row as always — hits are
+    block-aligned, so the suffix never overlaps a pool page.  Defaults
+    keep the trace byte-identical to the pre-paged program.
     """
     c = chunk_tokens.shape[1]
     start = jnp.asarray(start, jnp.int32)
@@ -999,6 +1066,12 @@ def prefill_chunk_program(
     positions = (start + jnp.arange(c))[None, :]
     pos_idx = start + jnp.arange(c)
     quantized = "k_scale" in cache
+    table_row = None
+    if block_table is not None:
+        table_row = jax.lax.dynamic_slice(
+            jnp.asarray(block_table, jnp.int32), (slot, jnp.int32(0)),
+            (1, block_table.shape[1]),
+        )
 
     x = layers.embedding_apply(params["embed"], chunk_tokens,
                                dtype=config.dtype, rules=rules, mesh=mesh)
@@ -1007,7 +1080,11 @@ def prefill_chunk_program(
                          mesh=mesh)
 
     def layer_body(x, layer_slice):
-        layer_params, cache_l = layer_slice
+        if pool is None:
+            layer_params, cache_l = layer_slice
+            pool_l = None
+        else:
+            layer_params, cache_l, pool_l = layer_slice
         y = layers.rmsnorm_apply(layer_params["ln1"], x)
         q, k_new, v_new = transformer.qkv_project(
             layer_params["att"], y, positions, config
@@ -1022,9 +1099,17 @@ def prefill_chunk_program(
             name: jax.lax.dynamic_slice_in_dim(leaf, slot, 1, axis=0)
             for name, leaf in cache_l.items()
         }
-        attended = _cache_attention(
-            q, row, jnp.reshape(start + 1, (1,)), chunk_causal=True
-        )
+        if table_row is None:
+            attended = _cache_attention(
+                q, row, jnp.reshape(start + 1, (1,)), chunk_causal=True
+            )
+        else:
+            attended = _paged_attended(
+                "chunk", q, row, jnp.reshape(start + 1, (1,)),
+                {"pool_l": pool_l, "block_table": table_row,
+                 "use_pallas": use_pallas,
+                 "partitioned": mesh is not None},
+            )
         att_out = layers.dense_apply(
             layer_params["att"]["out"], attended.reshape(1, c, -1)
         )
@@ -1035,7 +1120,10 @@ def prefill_chunk_program(
                              mesh=mesh)
         return x, cache_l
 
-    x, cache = jax.lax.scan(layer_body, x, (params["layers"], cache))
+    xs = (params["layers"], cache) if pool is None else (
+        params["layers"], cache, pool
+    )
+    x, cache = jax.lax.scan(layer_body, x, xs)
     last_idx = jnp.clip(chunk_len - 1, 0, c - 1)[None, None, None]
     last_x = jnp.take_along_axis(
         x, jnp.broadcast_to(last_idx, (1, 1, x.shape[-1])), axis=1
@@ -1150,6 +1238,9 @@ def verify_chunk_program(
     sample: SampleConfig = SampleConfig(temperature=0.0),
     rules: ShardingRules = DEFAULT_RULES,
     mesh=None,
+    pool=None,
+    block_table=None,
+    use_pallas=None,
 ):
     """Score a draft window for every slot in ONE target forward and
     commit the accepted prefix.
@@ -1209,7 +1300,11 @@ def verify_chunk_program(
     write_idx = jnp.where(active[:, None], positions, jnp.int32(s))
 
     def layer_body(x, layer_slice):
-        layer_params, cache_l = layer_slice
+        if pool is None:
+            layer_params, cache_l = layer_slice
+            pool_l = None
+        else:
+            layer_params, cache_l, pool_l = layer_slice
         y = layers.rmsnorm_apply(layer_params["ln1"], x)
         q, k_new, v_new = transformer.qkv_project(
             layer_params["att"], y, positions, config
@@ -1220,7 +1315,16 @@ def verify_chunk_program(
             cache_l[name] = cache_l[name].at[rows[:, None], write_idx].set(
                 val, mode="drop"
             )
-        attended = _cache_attention(q, cache_l, pos + 1, chunk_causal=True)
+        if block_table is None:
+            attended = _cache_attention(q, cache_l, pos + 1,
+                                        chunk_causal=True)
+        else:
+            attended = _paged_attended(
+                "verify", q, cache_l, pos + 1,
+                {"pool_l": pool_l, "block_table": block_table,
+                 "use_pallas": use_pallas,
+                 "partitioned": mesh is not None},
+            )
         att_out = layers.dense_apply(
             layer_params["att"]["out"], attended.reshape(num_slots, k, -1)
         )
@@ -1231,7 +1335,10 @@ def verify_chunk_program(
                              mesh=mesh)
         return x, cache_l
 
-    x, cache = jax.lax.scan(layer_body, x, (params["layers"], cache))
+    xs = (params["layers"], cache) if pool is None else (
+        params["layers"], cache, pool
+    )
+    x, cache = jax.lax.scan(layer_body, x, xs)
     logits = _final_logits(params, x, config)  # [slots, k, V]
     # Sampling boundary reshard (see _prefill_forward): once per forward.
     logits = shard_constraint(logits, "batch", None, None, rules=rules,

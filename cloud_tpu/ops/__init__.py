@@ -11,5 +11,17 @@ from cloud_tpu.utils import jax_compat as _jax_compat  # noqa: F401  (shims)
 from cloud_tpu.ops.flash_attention import flash_attention
 from cloud_tpu.ops.fused_cross_entropy import fused_linear_cross_entropy
 from cloud_tpu.ops.group_norm import group_norm
+from cloud_tpu.ops.paged_attention import (
+    paged_chunk_attention,
+    paged_decode_attention,
+    paged_verify_attention,
+)
 
-__all__ = ["flash_attention", "fused_linear_cross_entropy", "group_norm"]
+__all__ = [
+    "flash_attention",
+    "fused_linear_cross_entropy",
+    "group_norm",
+    "paged_chunk_attention",
+    "paged_decode_attention",
+    "paged_verify_attention",
+]

@@ -26,16 +26,20 @@ def force_interpret() -> bool:
     return os.environ.get("CLOUD_TPU_FLASH_FORCE_INTERPRET", "") == "1"
 
 
-def passthrough_callbacks(impl, n_results: int):
+def passthrough_callbacks(impl, n_results: int, result_like: int = 0):
     """(infer_sharding_from_operands, partition) for a rule-replicated
-    kernel: results [0..n_results) all shard like operand 0; the local
-    lowering is ``impl`` itself."""
+    kernel: results [0..n_results) all shard like operand
+    ``result_like`` (default 0 — kernels whose first operand is the
+    output-shaped one; paged attention passes the query's index, since
+    its scalar-prefetch operands lead); the local lowering is ``impl``
+    itself."""
 
     def infer(mesh, arg_shapes, result_shape):
-        return (arg_shapes[0].sharding,) * n_results
+        return (arg_shapes[result_like].sharding,) * n_results
 
     def part(mesh, arg_shapes, result_shape):
         arg_shardings = tuple(s.sharding for s in arg_shapes)
-        return mesh, impl, (arg_shardings[0],) * n_results, arg_shardings
+        return (mesh, impl, (arg_shardings[result_like],) * n_results,
+                arg_shardings)
 
     return infer, part

@@ -318,6 +318,20 @@ class ServeConfig:
     #: per-class health/stats keys read zero.  Host-side policy only:
     #: the compiled programs are untouched either way.
     qos: Optional[QosConfig] = None
+    #: Decode-attention path for the continuous slot grid.  ``"xla"``
+    #: (default) keeps today's programs byte-identical — plain
+    #: ``_cache_attention`` over the padded slot rows, prefix hits
+    #: copied into the row before decode.  ``"pallas"`` routes the
+    #: chunk/prefill-chunk/verify programs through
+    #: ``ops.paged_attention`` (block-table read-in-place: prefix hits
+    #: ATTACH pool blocks to the slot's block table instead of
+    #: dispatching ``copy_prefix_program``, and dead pages past each
+    #: row's length are skipped) with the Pallas kernel forced on;
+    #: ``"auto"`` takes the same paged route but lets the op's measured
+    #: crossover pick kernel vs its jnp reference per shape
+    #: (docs/KERNELS.md).  Greedy outputs are token-identical on every
+    #: setting.  Continuous-scheduler only.
+    decode_kernel: str = "xla"
 
     def __post_init__(self):
         from cloud_tpu.models.generation import SampleConfig
@@ -434,6 +448,18 @@ class ServeConfig:
                     "order is enforced; the batch path forms batches "
                     "by bucket, not by request"
                 )
+        if self.decode_kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"decode_kernel must be 'auto', 'pallas', or 'xla', "
+                f"got {self.decode_kernel!r}"
+            )
+        if self.decode_kernel != "xla" and self.scheduler != "continuous":
+            raise ValueError(
+                "decode_kernel= (paged decode attention) needs the "
+                "continuous scheduler — the block table pages slot rows "
+                "of the persistent grid; the batch path re-prefills a "
+                "fresh cache per batch"
+            )
         if self.layout not in ("explicit", "auto"):
             raise ValueError(
                 f"layout must be 'explicit' or 'auto', got {self.layout!r}"
@@ -796,6 +822,10 @@ class ServingEngine:
             "inserts": 0, "retires": 0, "expired": 0, "chunks": 0,
             # Prefix-cache / chunked-prefill counters (0 when disabled).
             "prefill_chunks": 0, "prefix_hits": 0, "prefix_misses": 0,
+            # Paged-attention block-table attaches (0 with
+            # decode_kernel="xla" — every hit then goes through the
+            # copy program instead).
+            "prefix_attaches": 0,
             # Speculative-decoding counters (0 when draft=None):
             # spec_chunks = verify (target) dispatches, spec_emitted =
             # tokens they committed, spec_proposed/accepted = draft
@@ -833,6 +863,11 @@ class ServingEngine:
         self._continuous = self.serve_config.scheduler == "continuous"
         #: Speculative decoding armed (continuous branch may flip it).
         self._spec = False
+        #: Paged decode attention armed (continuous branch may flip it);
+        #: ``_block_table`` is its host-side [num_slots, n_pages] mirror
+        #: (None on the XLA path and under the batch scheduler).
+        self._paged = False
+        self._block_table = None
         if self._continuous:
             cfg = self.serve_config
             #: Slot cache rows must fit the largest bucket's prompt plus
@@ -907,6 +942,26 @@ class ServingEngine:
                 self._prefix_pool = (
                     jax.jit(make_pool)() if self._slice_chips > 1
                     else make_pool()
+                )
+            #: Paged decode attention (``decode_kernel != "xla"``): the
+            #: slot grid's attention reads KV through a per-slot block
+            #: table — page p of a row resolves to a prefix-pool block
+            #: (entry >= 0) or the slot row itself (-1) — so a prefix
+            #: hit ATTACHES pool blocks instead of dispatching the copy
+            #: program, and pages past each row's length are skipped.
+            #: Page size is ``prefix_block_tokens`` (hits are whole
+            #: blocks, so attached pages align by construction).
+            self._paged = cfg.decode_kernel != "xla"
+            #: "pallas" forces the kernel; "auto" defers to the op's
+            #: measured-crossover dispatch (kernel on eligible TPU
+            #: shapes, jnp paged reference elsewhere).
+            self._paged_use_pallas = (
+                True if cfg.decode_kernel == "pallas" else None
+            )
+            if self._paged:
+                n_pages = -(-self._max_len // cfg.prefix_block_tokens)
+                self._block_table = np.full(
+                    (cfg.num_slots, n_pages), -1, np.int32
                 )
             #: Python-trace counters: the retrace guard for "one chunk
             #: compile serves the whole run" (tests/helpers/retrace_guard
@@ -1197,11 +1252,12 @@ class ServingEngine:
 
         cfg = self.serve_config
 
-        def verify_fn(params, cache, state, window):
+        def verify_fn(params, cache, state, window, *extra):
             self._verify_traces += 1
             return generation.verify_chunk_program(
                 params, cache, state, window, self.config,
                 sample=cfg.sample, rules=self.rules, mesh=self.mesh,
+                **self._paged_kwargs(extra),
             )
 
         donate = (1, 2) if self._donate else ()
@@ -1460,12 +1516,13 @@ class ServingEngine:
 
         cfg = self.serve_config
 
-        def chunk_fn(params, cache, state, rng):
+        def chunk_fn(params, cache, state, rng, *extra):
             self._chunk_traces += 1
             return generation.decode_chunk_program(
                 params, cache, state, self.config,
                 chunk_size=cfg.chunk_tokens, sample=cfg.sample, rng=rng,
                 rules=self.rules, mesh=self.mesh,
+                **self._paged_kwargs(extra),
             )
 
         donate = (1, 2) if self._donate else ()
@@ -1473,6 +1530,29 @@ class ServingEngine:
             jax.jit(chunk_fn, donate_argnums=donate),
             label="serve/decode_chunk",
         )
+
+    def _paged_extra(self) -> tuple:
+        """The extra traced operands every paged dispatch appends: the
+        prefix pool (when one exists — read-only, NEVER donated: the
+        attention reads its blocks in place) and the host block table.
+        Empty on the XLA path, so those cells' signatures — and their
+        compiled programs — stay byte-identical to pre-paged."""
+        if not self._paged:
+            return ()
+        if self._prefix_pool is not None:
+            return (self._prefix_pool, self._block_table)
+        return (self._block_table,)
+
+    def _paged_kwargs(self, extra: tuple) -> dict:
+        """Unpack ``_paged_extra``'s operands into the generation
+        programs' paged kwargs (inside a cell trace)."""
+        if not self._paged:
+            return {}
+        if len(extra) == 2:
+            return {"pool": extra[0], "block_table": extra[1],
+                    "use_pallas": self._paged_use_pallas}
+        return {"block_table": extra[0],
+                "use_pallas": self._paged_use_pallas}
 
     def _insert_cell(self, bucket_len: int):
         """The slot-insert program for one prompt bucket (compiled per
@@ -1519,11 +1599,12 @@ class ServingEngine:
             from cloud_tpu.training import compile_cache
 
             def chunk_prefill_fn(params, cache, tokens, start, chunk_len,
-                                 slot):
+                                 slot, *extra):
                 self._prefill_chunk_traces += 1
                 return generation.prefill_chunk_program(
                     params, cache, tokens, start, chunk_len, slot,
                     self.config, rules=self.rules, mesh=self.mesh,
+                    **self._paged_kwargs(extra),
                 )
 
             donate = (1,) if self._donate else ()
@@ -1747,6 +1828,21 @@ class ServingEngine:
             state_avals = compile_cache.abstract_state(self._slot_state)
             scalar = jax.ShapeDtypeStruct((), np.int32)
             use_chunks = cfg.prefill_chunk_tokens is not None
+            # Paged cells take the (pool,) table as extra operands —
+            # warm with matching avals so the AOT executable is the one
+            # traffic dispatches.
+            paged_avals: tuple = ()
+            if self._paged:
+                table_aval = jax.ShapeDtypeStruct(
+                    self._block_table.shape, np.int32
+                )
+                if self._prefix_pool is not None:
+                    paged_avals = (
+                        compile_cache.abstract_state(self._prefix_pool),
+                        table_aval,
+                    )
+                else:
+                    paged_avals = (table_aval,)
             jobs = []
             if not use_chunks:
                 # One-shot inserts serve cold prefills (and with
@@ -1774,7 +1870,7 @@ class ServingEngine:
                 tok_aval = jax.ShapeDtypeStruct((1, width), np.int32)
                 jobs.append((cell, (
                     params_avals, cache_avals, tok_aval, scalar, scalar,
-                    scalar,
+                    scalar, *paged_avals,
                 ), context))
             if widths:
                 logits_aval = jax.ShapeDtypeStruct(
@@ -1791,9 +1887,14 @@ class ServingEngine:
                     if n_blocks < 1:
                         continue
                     ids_aval = jax.ShapeDtypeStruct((n_blocks,), np.int32)
-                    jobs.append((self._copy_cell(bucket_len), (
-                        cache_avals, pool_avals, ids_aval, scalar,
-                    ), context))
+                    if not self._paged:
+                        # The paged path NEVER dispatches the copy
+                        # program (hits attach); warming it would both
+                        # waste a compile and advance _copy_traces,
+                        # breaking the zero-copy assertion.
+                        jobs.append((self._copy_cell(bucket_len), (
+                            cache_avals, pool_avals, ids_aval, scalar,
+                        ), context))
                     jobs.append((self._save_cell(bucket_len), (
                         pool_avals, cache_avals, scalar, ids_aval,
                     ), context))
@@ -1838,10 +1939,12 @@ class ServingEngine:
                 )
                 jobs.append((self._verify_step, (
                     params_avals, cache_avals, state_avals, window_aval,
+                    *paged_avals,
                 ), context))
             else:
                 jobs.append((self._chunk_step, (
                     params_avals, cache_avals, state_avals, rng_aval,
+                    *paged_avals,
                 ), context))
             self._warmup_plan = compile_cache.start_compile_ahead(jobs)
             return
@@ -2305,6 +2408,10 @@ class ServingEngine:
         advances one chunk per pass."""
         cfg = self.serve_config
         use_chunks = cfg.prefill_chunk_tokens is not None
+        if self._block_table is not None:
+            # Fresh claim: every page reads the slot row until a hit
+            # attaches pool blocks below.
+            self._block_table[slot, :] = -1
         hit = None
         held: List[object] = []
         swapin_plan = None
@@ -2366,7 +2473,10 @@ class ServingEngine:
             self._dispatch_swapin(slot, swapin_plan,
                                   trace_id=request.trace_id)
         if hit is not None and hit.tokens:
-            self._dispatch_copy(request, slot, hit)
+            if self._paged:
+                self._attach_prefix(request, slot, hit)
+            else:
+                self._dispatch_copy(request, slot, hit)
         width = (
             cfg.prefill_chunk_tokens if use_chunks else request.bucket_len
         )
@@ -2374,6 +2484,30 @@ class ServingEngine:
             request=request, slot=slot, chunk_width=width,
             next_pos=hit.tokens if hit is not None else 0, hit=hit,
         ))
+
+    def _attach_prefix(self, request: _Request, slot: int, hit) -> None:
+        """The paged path's whole prefix hit: point the slot's leading
+        block-table pages at the hit's pool blocks.  Zero device
+        dispatch — the chunk/prefill/verify programs read the pool rows
+        in place through the table.  Safe against eviction because the
+        hit's blocks are ref-pinned from the acquire in
+        ``_admit_request`` until ``_retire_slot`` releases them: a
+        pinned pool row is never evicted, demoted, or rewritten (the
+        save program's SKIP sentinel drops already-cached blocks), so
+        the bytes the table points at are immutable for the slot's
+        whole life."""
+        blocks = hit.blocks
+        with tracing.span(
+            "serve/prefix_attach",
+            **_trace_attrs(request, slot=slot, blocks=len(blocks),
+                           tokens=hit.tokens),
+        ):
+            self._block_table[slot, :len(blocks)] = np.asarray(
+                blocks, np.int32
+            )
+        metrics.counter_inc("serve/prefix_attached_blocks", len(blocks))
+        with self._stats_lock:
+            self._stats["prefix_attaches"] += 1
 
     def _dispatch_copy(self, request: _Request, slot: int, hit) -> None:
         """Copy an acquired hit's pool blocks into the slot row.  The
@@ -2419,6 +2553,7 @@ class ServingEngine:
             return cell(
                 self.params, self._grid_cache, tokens, np.int32(start_pos),
                 np.int32(clen), np.int32(task.slot),
+                *self._paged_extra(),
             )
 
         with tracing.span(
@@ -2597,6 +2732,7 @@ class ServingEngine:
             faults.fault_point("serve.chunk")
             return self._chunk_step(
                 self.params, self._grid_cache, self._slot_state, chunk_rng,
+                *self._paged_extra(),
             )
 
         span_attrs = dict(
@@ -2696,7 +2832,8 @@ class ServingEngine:
         def verify_dispatch():
             faults.fault_point("serve.verify")
             return self._verify_step(
-                self.params, self._grid_cache, self._slot_state, window
+                self.params, self._grid_cache, self._slot_state, window,
+                *self._paged_extra(),
             )
 
         span_attrs = dict(slots=num_slots, spec_k=k, active=active_n)
@@ -2784,6 +2921,11 @@ class ServingEngine:
         entry = self._slot_table[slot]
         self._slot_table[slot] = None
         self._active_slots.discard(slot)
+        if self._block_table is not None:
+            # Detach before the pins below release: a stale table row
+            # must never outlive the references that made its pool
+            # blocks immutable.
+            self._block_table[slot, :] = -1
         if entry.prefix_nodes and self._prefix is not None:
             # Drop this slot's references; blocks shared with another
             # in-flight slot stay pinned until IT retires too.
@@ -3042,6 +3184,9 @@ class ServingEngine:
             # schema, so the fleet's per-class backlog aggregation and
             # the autoscaler's class signal read without probing.
             "class_backlog": class_backlog,
+            # The armed decode-attention path ("xla" default; stable
+            # schema — the batch scheduler only ever reports "xla").
+            "decode_kernel": self.serve_config.decode_kernel,
         }
         snap.update(self._prefix_snapshot())
         if self._continuous:

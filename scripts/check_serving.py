@@ -41,7 +41,12 @@ deadline-shed request landing while verifies are in flight, plus a
 genuinely smaller (1-layer, fresh-init) draft segment whose acceptance
 is whatever it is — parity vs per-request ``generate()`` either way,
 one draft/verify/draft-prefill executable each (retrace guard), and
-zero leaked threads.
+zero leaked threads.  Phase 6 is the KERNEL churn: the shared-prefix
+workload with the paged decode-attention kernel armed
+(``decode_kernel="pallas"``, real Pallas kernel body through the
+interpreter via ``CLOUD_TPU_PAGED_FORCE_INTERPRET=1``) — per-request
+parity, compile-once programs, and prefix hits attaching through the
+block table with ZERO ``copy_prefix_program`` dispatches.
 
 Prints one JSON line per phase plus a final summary::
 
@@ -632,18 +637,130 @@ def main(argv=None) -> int:
     }), flush=True)
     leaked_spec = _engine_threads()
 
+    # -- phase 6: kernel churn (paged decode attention, interpret mode) ---
+    # The shared-prefix churn workload with the paged decode kernel
+    # ARMED (decode_kernel="pallas"): on this CPU rig the dedicated
+    # interpret knob runs the real Pallas kernel body through the
+    # interpreter (not the jnp reference), so the block-table gather,
+    # the online-softmax loop, and the no-copy prefix-attach path are
+    # all what's under test.  Gates: per-request parity vs generate(),
+    # one-executable retrace guard, prefix hits attaching via the block
+    # table with ZERO copy_prefix_program dispatches (the kernel path's
+    # reason to exist), and zero leaked threads.
+    os.environ["CLOUD_TPU_PAGED_FORCE_INTERPRET"] = "1"
+    kernel_serve = ServeConfig(
+        max_new_tokens=MAX_NEW,
+        prompt_buckets=(8, 16),
+        batch_buckets=(1, 2, 4),
+        chunk_tokens=2,
+        prefix_cache_blocks=16,
+        prefix_block_tokens=4,
+        prefill_chunk_tokens=4,
+        warmup=True,
+        decode_kernel="pallas",
+    )
+    kernel_rng = np.random.default_rng(7)
+    kernel_heads = [
+        kernel_rng.integers(1, 255, 12).astype(np.int32) for _ in range(3)
+    ]
+    kernel_prompts = [
+        np.concatenate([
+            kernel_heads[i % len(kernel_heads)],
+            kernel_rng.integers(
+                1, 255, int(kernel_rng.integers(1, 4))
+            ).astype(np.int32),
+        ])
+        for i in range(args.requests)
+    ]
+    kernel_budgets = [
+        int(kernel_rng.integers(1, max(MAX_NEW // 2, 2)))
+        for _ in kernel_prompts
+    ]
+    kernel_futures = [None] * len(kernel_prompts)
+    kernel_engine = ServingEngine(params, config, kernel_serve, mesh=None)
+    try:
+        kernel_engine.wait_ready()
+
+        def kernel_submitter(i):
+            time.sleep(float(i % 5) * 0.005)
+            kernel_futures[i] = kernel_engine.submit(
+                kernel_prompts[i], max_new_tokens=kernel_budgets[i]
+            )
+
+        kernel_workers = [
+            threading.Thread(target=kernel_submitter, args=(i,))
+            for i in range(len(kernel_prompts))
+        ]
+        for w in kernel_workers:
+            w.start()
+        for w in kernel_workers:
+            w.join()
+        kernel_results = [
+            f.result(timeout=args.timeout) for f in kernel_futures
+        ]
+
+        kernel_mismatches = 0
+        for prompt, budget, result in zip(kernel_prompts, kernel_budgets,
+                                          kernel_results):
+            direct = generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([len(prompt)], np.int32), config,
+                max_new_tokens=budget,
+                sample=generation.SampleConfig(temperature=0.0),
+            )
+            want = np.asarray(direct["tokens"])[0]
+            if not np.array_equal(result.tokens, want) or (
+                result.num_generated != int(direct["num_generated"][0])
+            ):
+                kernel_mismatches += 1
+        kernel_stats = kernel_engine.stats()
+        kernel_health = kernel_engine.health()
+    finally:
+        kernel_engine.close()
+        os.environ.pop("CLOUD_TPU_PAGED_FORCE_INTERPRET", None)
+    # Retrace guard: same budget as the prefix phase — plus the
+    # tentpole's contract, the copy program NEVER compiled (hits attach
+    # through the block table instead of copying pool bytes).
+    kernel_retrace_ok = (
+        kernel_engine.chunk_traces == 1
+        and kernel_engine._prefill_chunk_traces <= 1
+        and kernel_engine._finalize_traces <= 1
+        and kernel_engine._copy_traces == 0
+        and kernel_engine._save_traces
+        <= len(kernel_serve.prompt_buckets)
+    )
+    kernel_nocopy_ok = (
+        kernel_stats["prefix_hits"] > 0
+        and kernel_stats["prefix_attaches"] > 0
+        and kernel_engine._copy_traces == 0
+    )
+    print(json.dumps({
+        "phase": "kernel_churn",
+        "ok": kernel_mismatches == 0,
+        "mismatches": kernel_mismatches,
+        "decode_kernel": kernel_health["decode_kernel"],
+        "prefix_hits": kernel_stats["prefix_hits"],
+        "prefix_attaches": kernel_stats["prefix_attaches"],
+        "copy_compiles": kernel_engine._copy_traces,
+        "nocopy_ok": kernel_nocopy_ok,
+        "retrace_ok": kernel_retrace_ok,
+    }), flush=True)
+    leaked_kernel = _engine_threads()
+
     ok = (
         mismatches == 0 and churn_mismatches == 0
         and prefix_mismatches == 0 and tp_mismatches == 0
         and spec_mismatches == 0 and small_mismatches == 0
+        and kernel_mismatches == 0
         and not leaked and not leaked_churn and not leaked_prefix
-        and not leaked_tp and not leaked_spec
+        and not leaked_tp and not leaked_spec and not leaked_kernel
         and stats["completed"] == len(prompts)
         and churn_stats["completed"] == len(churn_prompts)
         and prefix_stats["completed"] == len(prefix_prompts)
         and tp_stats["completed"] == len(tp_prompts)
         and spec_stats["completed"] == len(spec_prompts)
         and small_stats["completed"] == len(small_prompts)
+        and kernel_stats["completed"] == len(kernel_prompts)
         # The whole churn run — reuse, expiry, staggered inserts — must
         # have retraced the chunk program exactly once.
         and churn_engine.chunk_traces == 1
@@ -664,6 +781,11 @@ def main(argv=None) -> int:
         and spec_shed_ok
         and spec_retrace_ok
         and small_floor_ok
+        # Kernel phase: parity through the interpreted Pallas kernel,
+        # hits attached read-in-place (zero copy compiles), compile-once
+        # programs.
+        and kernel_nocopy_ok
+        and kernel_retrace_ok
     )
     print(json.dumps({
         "phase": "summary",
@@ -674,11 +796,13 @@ def main(argv=None) -> int:
         "requests": (stats["requests"] + churn_stats["requests"]
                      + prefix_stats["requests"] + tp_stats["requests"]
                      + spec_stats["requests"] - spec_stats["shed"]
-                     + small_stats["requests"]),
+                     + small_stats["requests"]
+                     + kernel_stats["requests"]),
         "completed": (stats["completed"] + churn_stats["completed"]
                       + prefix_stats["completed"]
                       + tp_stats["completed"] + spec_stats["completed"]
-                      + small_stats["completed"]),
+                      + small_stats["completed"]
+                      + kernel_stats["completed"]),
         "batches": stats["batches"],
         "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 3),
         "continuous_occupancy": round(
@@ -690,8 +814,9 @@ def main(argv=None) -> int:
             spec_stats["spec_acceptance_rate"], 3
         ),
         "spec_dispatches_lt_tokens": spec_dispatch_ok,
+        "kernel_nocopy_ok": kernel_nocopy_ok,
         "leaked_threads": (leaked + leaked_churn + leaked_prefix
-                           + leaked_tp + leaked_spec),
+                           + leaked_tp + leaked_spec + leaked_kernel),
         "wall_seconds": round(time.perf_counter() - start, 3),
     }), flush=True)
     return 0 if ok else 1

@@ -1,0 +1,70 @@
+"""Find the context-length crossover where the paged Pallas decode kernel
+beats the XLA gather+attention reference on this chip.  Prints one JSON
+line per (T, path) with single-token decode timing — the serving hot
+path's shape (batch of slots, one query token each, block-table KV).
+
+Feed the winner into ``CLOUD_TPU_PAGED_MIN_LEN`` (and the table in
+docs/KERNELS.md): ``decode_kernel="auto"`` uses the kernel only at or
+above that context length."""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+from cloud_tpu.ops.paged_attention import paged_decode_attention
+
+
+def bench(t, use_pallas, b=8, h=12, d=64, bt=128, iters=50):
+    keys = jax.random.split(jax.random.PRNGKey(0), 5)
+    q = jax.random.normal(keys[0], (b, 1, h, d), jnp.bfloat16)
+    cache = {
+        "k": jax.random.normal(keys[1], (b, t, h, d), jnp.bfloat16),
+        "v": jax.random.normal(keys[2], (b, t, h, d), jnp.bfloat16),
+    }
+    n_pages = -(-t // bt)
+    n_blocks = max(b * n_pages // 2, 1)
+    pool = {
+        "k": jax.random.normal(keys[3], (n_blocks, bt, h, d), jnp.bfloat16),
+        "v": jax.random.normal(keys[4], (n_blocks, bt, h, d), jnp.bfloat16),
+    }
+    # Half the pages pool-backed, half slot-backed: the serving mix.
+    table = jnp.where(
+        (jnp.arange(b * n_pages) % 2 == 0).reshape(b, n_pages),
+        jnp.arange(b * n_pages).reshape(b, n_pages) % n_blocks,
+        -1,
+    ).astype(jnp.int32)
+    cur_len = jnp.full((b,), t, jnp.int32)
+
+    def step(q, cache, pool):
+        return paged_decode_attention(
+            q, cache, cur_len, pool_l=pool, block_table=table,
+            use_pallas=use_pallas,
+        )
+
+    step = jax.jit(step)
+    out = step(q, cache, pool)
+    out.block_until_ready()
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = step(out + q, cache, pool)  # chain to defeat overlap
+    out.block_until_ready()
+    return (time.perf_counter() - start) / iters
+
+
+def main():
+    for t in (256, 512, 1024, 2048, 4096, 8192):
+        for use_pallas in (False, True):
+            us = bench(t, use_pallas) * 1e6
+            print(json.dumps({"T": t, "pallas": use_pallas,
+                              "us_per_decode": round(us, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

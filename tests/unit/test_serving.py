@@ -415,6 +415,9 @@ class TestHealth:
         assert health["class_backlog"] == {
             "interactive": 0, "standard": 0, "batch": 0,
         }
+        # ISSUE 17: the decode-kernel selection is schema in BOTH
+        # schedulers — the default is (and must stay) the XLA path.
+        assert health["decode_kernel"] == "xla"
 
     def _assert_qos_stats_zero(self, stats):
         """ISSUE 14: the QoS stats keys are schema in both schedulers —
@@ -427,6 +430,9 @@ class TestHealth:
         # ISSUE 16: the traced-request counter is schema in both
         # schedulers too — zero whenever requests carry no context.
         assert stats["traced"] == 0
+        # ISSUE 17: block-table prefix attaches are schema too — zero
+        # whenever decode_kernel="xla" (hits copy, never attach).
+        assert stats["prefix_attaches"] == 0
 
     def test_continuous_health_carries_load_signal(self, model):
         config, params = model
@@ -464,6 +470,178 @@ class TestHealth:
             self._assert_qos_stats_zero(engine.stats())
         finally:
             engine.close(drain=False)
+
+
+class TestDecodeKernel:
+    """ISSUE 17: the paged decode-attention kernel on the serving path.
+
+    ``decode_kernel="pallas"`` routes decode / chunked-prefill / verify
+    attention through the block-table paged kernel (interpreted on this
+    CPU rig — the same kernel body Mosaic compiles on TPU), and the
+    contract is the usual one: token-identical to per-request
+    ``generate()``, with prefix hits attaching pool blocks read-in-place
+    instead of dispatching ``copy_prefix_program``.  The default
+    ``"xla"`` config must stay byte-identical to pre-PR behavior."""
+
+    def _parity(self, model, serve, prompts, budgets=None):
+        config, params = model
+        budgets = budgets or [serve.max_new_tokens] * len(prompts)
+        engine = ServingEngine(params, config, serve)
+        try:
+            futures = [
+                engine.submit(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)
+            ]
+            results = [f.result(timeout=240) for f in futures]
+            for prompt, budget, result in zip(prompts, budgets, results):
+                want = _direct(params, config, prompt, budget)
+                np.testing.assert_array_equal(
+                    result.tokens, np.asarray(want["tokens"])[0]
+                )
+                assert result.num_generated == int(
+                    want["num_generated"][0]
+                )
+            return engine, engine.stats()
+        finally:
+            engine.close()
+
+    def test_pallas_cold_insert_parity(self, model):
+        from cloud_tpu.ops import paged_attention
+
+        before = paged_attention.KERNEL_TRACE_COUNT
+        serve = ServeConfig(
+            max_new_tokens=3, prompt_buckets=(8,), batch_buckets=(1, 2),
+            chunk_tokens=2, decode_kernel="pallas",
+        )
+        prompts = [np.asarray([5, 3, 1], np.int32),
+                   np.asarray([9, 2, 7, 4, 6], np.int32)]
+        engine, _ = self._parity(model, serve, prompts)
+        assert engine.health()["decode_kernel"] == "pallas"
+        # The kernel path (not the jnp reference) is what traced.
+        assert paged_attention.KERNEL_TRACE_COUNT > before
+
+    def test_pallas_kv_quant_parity(self, model):
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=3, prompt_buckets=(8,), batch_buckets=(1, 2),
+            chunk_tokens=2, kv_quant=True, decode_kernel="pallas",
+        )
+        prompts = [np.asarray([5, 3, 1], np.int32),
+                   np.asarray([9, 2, 7, 4, 6], np.int32)]
+        with ServingEngine(params, config, serve) as engine:
+            futures = [engine.submit(p) for p in prompts]
+            results = [f.result(timeout=240) for f in futures]
+        for prompt, result in zip(prompts, results):
+            # The oracle is QUANTIZED generate: kv_quant rounding is the
+            # engine's pre-existing contract; the kernel must match it
+            # bit for bit, not the f32 path.
+            direct = generation.generate(
+                params, jnp.asarray(prompt[None, :]),
+                jnp.asarray([len(prompt)], np.int32), config,
+                max_new_tokens=3,
+                sample=generation.SampleConfig(temperature=0.0),
+                kv_quant=True,
+            )
+            np.testing.assert_array_equal(
+                result.tokens, np.asarray(direct["tokens"])[0]
+            )
+
+    def test_pallas_speculation_parity(self, model):
+        from cloud_tpu.serving import DraftConfig
+
+        config, params = model
+        serve = ServeConfig(
+            max_new_tokens=4, prompt_buckets=(8,), batch_buckets=(1, 2),
+            draft=DraftConfig(config=config, params=params, spec_k=2),
+            decode_kernel="pallas",
+        )
+        prompts = [np.asarray([5, 3, 1], np.int32),
+                   np.asarray([9, 2, 7, 4, 6], np.int32)]
+        engine, stats = self._parity(model, serve, prompts)
+        assert stats["spec_chunks"] > 0  # the verify path actually ran
+
+    def test_pallas_prefix_hit_attaches_without_copy(self, model):
+        """The tentpole's acceptance bar: a prefix hit under the kernel
+        path attaches pool blocks through the block table — parity
+        holds, the attach stat advances, and the copy program is NEVER
+        compiled (warmup included)."""
+        from cloud_tpu.monitoring import tracing
+
+        serve = ServeConfig(
+            max_new_tokens=3, prompt_buckets=(16,), batch_buckets=(1, 2),
+            chunk_tokens=2, prefix_cache_blocks=8, prefix_block_tokens=4,
+            prefill_chunk_tokens=4, warmup=False,
+            decode_kernel="pallas",
+        )
+        head = np.asarray([7, 1, 4, 2, 9, 3, 5, 8], np.int32)
+        prompts = [np.concatenate([head, [11]]).astype(np.int32),
+                   np.concatenate([head, [13, 12]]).astype(np.int32)]
+        config, params = model
+        engine = ServingEngine(params, config, serve)
+        try:
+            with tracing.collecting() as collector:
+                # Sequential: the second request must hit the first's
+                # saved blocks.
+                for prompt in prompts:
+                    result = engine.submit(prompt).result(timeout=240)
+                    want = _direct(params, config, prompt, 3)
+                    np.testing.assert_array_equal(
+                        result.tokens, np.asarray(want["tokens"])[0]
+                    )
+            stats = engine.stats()
+        finally:
+            engine.close()
+        assert stats["prefix_hits"] >= 1
+        assert stats["prefix_attaches"] >= 1
+        assert engine._copy_traces == 0
+        agg = collector.aggregates()
+        assert agg.get("serve/prefix_attach", {}).get("count", 0) >= 1
+        assert not any(
+            e["name"] == "serve/prefix_copy" for e in collector.events()
+        )
+
+    def test_xla_default_is_inert(self, model):
+        """Byte-identity pin for the default config: no block table, no
+        attach stat movement, prefix hits still COPY (the pre-PR path),
+        and no ``serve/prefix_attach`` span ever emitted."""
+        from cloud_tpu.monitoring import tracing
+
+        serve = ServeConfig(
+            max_new_tokens=3, prompt_buckets=(16,), batch_buckets=(1, 2),
+            chunk_tokens=2, prefix_cache_blocks=8, prefix_block_tokens=4,
+            warmup=False,
+        )
+        assert serve.decode_kernel == "xla"
+        head = np.asarray([7, 1, 4, 2, 9, 3, 5, 8], np.int32)
+        prompts = [np.concatenate([head, [11]]).astype(np.int32),
+                   np.concatenate([head, [13, 12]]).astype(np.int32)]
+        config, params = model
+        engine = ServingEngine(params, config, serve)
+        try:
+            with tracing.collecting() as collector:
+                for prompt in prompts:
+                    result = engine.submit(prompt).result(timeout=240)
+                    want = _direct(params, config, prompt, 3)
+                    np.testing.assert_array_equal(
+                        result.tokens, np.asarray(want["tokens"])[0]
+                    )
+            stats = engine.stats()
+        finally:
+            engine.close()
+        assert engine._block_table is None
+        assert stats["prefix_hits"] >= 1
+        assert stats["prefix_attaches"] == 0
+        assert engine._copy_traces >= 1  # hits still copy, as pre-PR
+        assert not any(
+            e["name"] == "serve/prefix_attach"
+            for e in collector.events()
+        )
+
+    def test_decode_kernel_validation(self):
+        with pytest.raises(ValueError, match="decode_kernel"):
+            ServeConfig(decode_kernel="bogus")
+        with pytest.raises(ValueError, match="decode_kernel"):
+            ServeConfig(scheduler="batch", decode_kernel="pallas")
 
 
 class TestObservability:
