@@ -184,6 +184,20 @@ FLEET_SWEEP_NEW_TOKENS = 16
 #: to hold every arrival in flight would measure nothing but decode.
 FLEET_SWEEP_SLOTS = 2
 
+#: Disaggregated-vs-colocated probe (ISSUE 19): one long-prompt flash
+#: crowd served twice — a 3-replica colocated fleet, then the SAME
+#: replica count split 1 prefill / 2 decode with KV block handoff
+#: through the host DRAM pool.  Prompts share a head so the prefill
+#: replica's exports dedup in the pool.  Per-arm TTFT/TPOT p50/p99 plus
+#: handoff counters; tokens must match across arms (the handoff path is
+#: bit-exact by construction and this probe re-proves it per round).
+DISAGG_REPLICAS = 3
+DISAGG_REQUESTS = 12
+DISAGG_PROMPT_LEN = 120
+DISAGG_PROMPT_BUCKET = 128
+DISAGG_SHARED_HEAD = 24
+DISAGG_NEW_TOKENS = 16
+
 METRIC = f"resnet50_cifar10_b{BATCH_SIZE}_train_steps_per_sec_per_chip"
 
 #: The last DRIVER-VERIFIED number (BENCH_r02.json, 2026-07-29, TPU v5e-1,
@@ -1587,6 +1601,129 @@ def _measure_fleet_qps_sweep(extras):
     )
 
 
+def _measure_fleet_disagg(extras):
+    """Disaggregated serving probe: one long-prompt flash crowd through
+    a colocated 3-replica fleet, then through the same replica count
+    split 1 prefill / 2 decode (``FleetConfig.roles``) with KV block
+    handoff riding the shared host-DRAM prefix pool.  Emits per-arm
+    TTFT/TPOT p50/p99 and tokens/sec plus the disagg arm's handoff /
+    dedup counters, and GATES on cross-arm token identity — the probe
+    re-proves the handoff path bit-exact every round, not just in the
+    unit suite.  (Chaos coverage — mid-flood replica kills — lives in
+    scripts/check_fleet.py phase 5; this probe measures the healthy
+    steady state.)
+    """
+    from cloud_tpu.fleet import Fleet, FleetConfig
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils.benchmarking import decode_setup
+
+    import numpy as np
+
+    cfg, params, _, _ = decode_setup(
+        batch_size=2, prompt_len=DISAGG_PROMPT_BUCKET
+    )
+    serve = ServeConfig(
+        max_new_tokens=DISAGG_NEW_TOKENS,
+        prompt_buckets=(DISAGG_PROMPT_BUCKET,),
+        batch_buckets=(1, 2),
+        num_slots=2,
+        chunk_tokens=SERVE_CHURN_CHUNK,
+        prefix_cache_blocks=96,
+        prefix_block_tokens=8,
+        prefill_chunk_tokens=32,
+        warmup=True,
+    )
+
+    def factory():
+        return ServingEngine(params, cfg, serve, mesh=None)
+
+    rng = np.random.default_rng(19)
+    head = rng.integers(1, cfg.vocab_size, DISAGG_SHARED_HEAD)
+    prompts = [
+        np.concatenate([
+            head,
+            rng.integers(
+                1, cfg.vocab_size, DISAGG_PROMPT_LEN - DISAGG_SHARED_HEAD
+            ),
+        ]).astype(np.int32)
+        for _ in range(DISAGG_REQUESTS)
+    ]
+
+    reference = None
+    for arm, roles in (
+        ("colocated", None),
+        ("disagg", ("prefill", "decode", "decode")),
+    ):
+        with Fleet(factory, FleetConfig(
+            min_replicas=DISAGG_REPLICAS, max_replicas=DISAGG_REPLICAS,
+            poll_interval_s=0.1, roles=roles,
+        )) as fleet:
+            fleet.wait_ready()
+            # Absorb residual first-dispatch latency (and, in the disagg
+            # arm, the first prefill->decode leg pair) outside the clock.
+            fleet.submit(
+                rng.integers(1, cfg.vocab_size, 8).astype(np.int32),
+                max_new_tokens=2,
+            ).result()
+            start = time.perf_counter()
+            # Flash crowd: one burst, no staggering — the arm contrast
+            # IS how each topology absorbs simultaneous long prefills.
+            futures = [
+                fleet.submit(p, max_new_tokens=DISAGG_NEW_TOKENS)
+                for p in prompts
+            ]
+            results = [f.result() for f in futures]
+            wall = time.perf_counter() - start
+            stats = fleet.stats()
+
+        tokens = [tuple(int(t) for t in r.tokens) for r in results]
+        if reference is None:
+            reference = tokens
+        elif tokens != reference:
+            diverged = sum(a != b for a, b in zip(tokens, reference))
+            raise RuntimeError(
+                f"fleet_disagg: {diverged}/{len(tokens)} requests "
+                "decoded different tokens in the disagg arm"
+            )
+        ttfts = sorted(r.ttft_seconds for r in results)
+        tpots = sorted(
+            (r.latency_seconds - r.ttft_seconds)
+            / max(r.num_generated - 1, 1)
+            for r in results
+        )
+        total_tokens = sum(r.num_generated for r in results)
+        key = f"fleet_disagg_{arm}"
+        extras[f"{key}_tokens_per_sec"] = round(total_tokens / wall, 1)
+        extras[f"{key}_ttft_p50_seconds"] = round(
+            _latency_pct(ttfts, 0.5), 4
+        )
+        extras[f"{key}_ttft_p99_seconds"] = round(
+            _latency_pct(ttfts, 0.99), 4
+        )
+        extras[f"{key}_tpot_p50_seconds"] = round(
+            _latency_pct(tpots, 0.5), 5
+        )
+        extras[f"{key}_tpot_p99_seconds"] = round(
+            _latency_pct(tpots, 0.99), 5
+        )
+        extras[f"{key}_handoffs"] = stats["handoffs"]
+        extras[f"{key}_handoff_failovers"] = stats["handoff_failovers"]
+        if roles is not None:
+            extras["fleet_disagg_host_pool_puts"] = (
+                stats["host_pool"]["puts"]
+            )
+            extras["fleet_disagg_host_pool_dedup_hits"] = (
+                stats["host_pool"]["dedup_hits"]
+            )
+    extras["fleet_disagg_config"] = (
+        f"SMALL replicas{DISAGG_REPLICAS} colocated vs "
+        "prefill1/decode2 flash-crowd "
+        f"n{DISAGG_REQUESTS} prompt{DISAGG_PROMPT_LEN} "
+        f"head{DISAGG_SHARED_HEAD} new{DISAGG_NEW_TOKENS} "
+        "token-identity gated"
+    )
+
+
 def _measure_durability(extras):
     """Durability probe on the CIFAR workload (the headline's state):
 
@@ -1716,6 +1853,7 @@ def _child_main() -> int:
         (_measure_serving_decode_kernel, "serving_decode_kernel"),
         (_measure_fleet, "fleet"),
         (_measure_fleet_qps_sweep, "fleet_qps_sweep"),
+        (_measure_fleet_disagg, "fleet_disagg"),
         (_measure_durability, "durability"),
     ):
         phase_extras = {"peak_bf16_tflops": extras.get("peak_bf16_tflops")}

@@ -15,7 +15,7 @@ import logging
 import os
 import time
 import uuid
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 from cloud_tpu.core import gcp, machine_config
 from cloud_tpu.parallel import planner
@@ -239,6 +239,7 @@ def build_serve_fleet_request(
     profiler_port: Optional[int] = None,
     submit_ts: Optional[float] = None,
     compile_cache: Optional[str] = None,
+    roles: Optional[Sequence[str]] = None,
 ) -> dict:
     """Node bodies for a serve FLEET: N independent single-slice replicas.
 
@@ -264,16 +265,38 @@ def build_serve_fleet_request(
     size health checks and dial slices without parsing startup scripts.
     A single-chip fleet degenerates to ``workers_per_replica=1`` with
     the same schema.
+
+    ``roles`` is the disaggregated prefill/decode assignment, one of
+    ``"prefill" | "decode" | "both"`` per replica index (padded with
+    ``"both"`` when shorter than the fleet; validated by
+    ``fleet.disagg.validate_roles`` — a split fleet must keep at least
+    one replica on each side).  The ``slice_topology`` block grows a
+    ``roles`` axis (node id -> role) and each node carries its role as
+    the ``cloud_tpu_serve_role`` label, so a fronting router can
+    enumerate the prefill and decode pools by label alone.  ``None``
+    (the default) records every replica as ``"both"`` — the colocated
+    fleet, same schema.
     """
+    from cloud_tpu.fleet import disagg
+
     if num_replicas < 1:
         raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+    if roles is not None and len(roles) > num_replicas:
+        raise ValueError(
+            f"roles has {len(roles)} entries for {num_replicas} replicas"
+        )
+    padded = list(roles or ())
+    padded += ["both"] * (num_replicas - len(padded))
+    padded = list(disagg.validate_roles(padded))
     job_id = job_id or _job_id()
     hosts = plan.hosts_per_slice
     nodes = {}
     coordinators = {}
+    node_roles = {}
     for i in range(num_replicas):
         node_id = f"{job_id}-r{i}"
         coordinators[node_id] = f"{node_id}-w0:8476"
+        node_roles[node_id] = padded[i]
         nodes[node_id] = build_node_request(
             image_uri,
             replica_config,
@@ -285,6 +308,7 @@ def build_serve_fleet_request(
                 "cloud_tpu_job": job_id,
                 "cloud_tpu_role": "serve-replica",
                 "cloud_tpu_replica": str(i),
+                "cloud_tpu_serve_role": padded[i],
             },
             service_account=service_account,
             monitoring=monitoring,
@@ -300,6 +324,7 @@ def build_serve_fleet_request(
             "workers_per_replica": hosts,
             "chips_per_replica": plan.chips_per_slice,
             "coordinators": coordinators,
+            "roles": node_roles,
         },
     }
 

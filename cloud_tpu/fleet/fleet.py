@@ -59,6 +59,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from cloud_tpu.fleet import disagg
 from cloud_tpu.fleet.autoscaler import AutoscaleConfig, QueueDepthAutoscaler
 from cloud_tpu.fleet.replica import Replica
 from cloud_tpu.fleet.router import LeastLoadedRouter
@@ -171,6 +172,20 @@ class FleetConfig:
     #: read zero).  Independent of the engines' own ``ServeConfig.qos``
     #: — arm both for end-to-end class ordering.
     qos: Optional[QosConfig] = None
+    #: Disaggregated prefill/decode roles, one per initial replica id
+    #: (``fleet.disagg`` module docstring).  ``None`` (default) — and a
+    #: tuple of all ``"both"`` — keep the colocated fleet byte-identical:
+    #: no handoff legs are ever built.  With any ``"prefill"``/
+    #: ``"decode"`` entry, new requests route to prefill-capable
+    #: replicas; a prefill-ONLY replica serves the first token, exports
+    #: its prompt KV blocks, and the request re-enters the queue as a
+    #: decode leg routed to a decode-capable replica.  Replicas beyond
+    #: the tuple (autoscaler scale-ups) default to ``"both"``.
+    roles: Optional[tuple] = None
+    #: Capacity (blocks) of the shared per-host DRAM pool deduplicating
+    #: handoff payload bytes across replicas (only built when ``roles``
+    #: arms disaggregation).
+    host_pool_blocks: int = 1024
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -197,6 +212,15 @@ class FleetConfig:
             raise ValueError(
                 f"qos must be a serving.qos.QosConfig, got "
                 f"{type(self.qos).__name__}"
+            )
+        if self.roles is not None:
+            object.__setattr__(
+                self, "roles", disagg.validate_roles(self.roles)
+            )
+        if self.host_pool_blocks < 1:
+            raise ValueError(
+                f"host_pool_blocks must be >= 1, "
+                f"got {self.host_pool_blocks}"
             )
         base = self.autoscale or AutoscaleConfig()
         object.__setattr__(self, "autoscale", dataclasses.replace(
@@ -238,6 +262,16 @@ class _FleetRequest:
     #: failover re-admission carries the same identity, which is what
     #: lets report.py stitch a request's hops across replicas.
     trace: Optional[tracing.TraceContext] = None
+    #: Disaggregated-serving phase marker: None = prefill phase (route
+    #: like any new request); a payload dict = decode leg — the prefill
+    #: replica's exported KV blocks travel here (slimmed through the
+    #: host pool) and the router offers the request only to
+    #: decode-capable replicas.  A decode-leg failure RESETS this to
+    #: None: a dead decode replica re-prefills at another prefill
+    #: replica.
+    handoff: Optional[dict] = None
+    #: Replica id that served the prefill leg (span attribution only).
+    prefill_replica: Optional[int] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -293,6 +327,20 @@ class Fleet:
             pick_params = {}
         self._pick_takes_affinity = "affinity_key" in pick_params
         self._pick_takes_priority = "priority" in pick_params
+        self._pick_takes_role = "role" in pick_params
+        #: Disaggregation armed: any configured role differs from
+        #: "both".  Off (the default) keeps every routing and submit
+        #: path byte-identical to the colocated fleet.
+        self._roles = self.config.roles
+        self._disagg = bool(
+            self._roles and any(r != "both" for r in self._roles)
+        )
+        #: Shared per-host DRAM pool deduplicating handoff payload
+        #: bytes across replicas (None without disaggregation).
+        self._host_pool = (
+            disagg.HostPrefixPool(self.config.host_pool_blocks)
+            if self._disagg else None
+        )
         self._route_policy = (
             self.config.route_policy
             if self.config.route_policy is not None
@@ -341,6 +389,10 @@ class Fleet:
             # Requests submitted carrying a TraceContext (0 with
             # tracing off — stable schema either way).
             "traced": 0,
+            # Disaggregated serving (0 with roles off — stable schema):
+            # prefill->decode handoffs completed, and decode-leg
+            # failures that reset a request to re-prefill.
+            "handoffs": 0, "handoff_failovers": 0,
         }
         self._routed: Dict[int, int] = {}
 
@@ -772,6 +824,13 @@ class Fleet:
                 pick_kwargs["affinity_key"] = request.affinity_key
             if self._pick_takes_priority and request.priority is not None:
                 pick_kwargs["priority"] = request.priority
+            if self._disagg and self._pick_takes_role:
+                # Leg-aware candidate filter: a decode leg (handoff
+                # payload attached) only lands on decode-capable
+                # replicas; everything else routes prefill-capable.
+                pick_kwargs["role"] = (
+                    "decode" if request.handoff is not None else "prefill"
+                )
             replica, health = self._router.pick(
                 candidates, exclude=tried, **pick_kwargs
             )
@@ -803,10 +862,36 @@ class Fleet:
                 # signature at start(), same idiom as the router-pick
                 # probes above).
                 extra["trace"] = request.trace
+            # Disaggregated legs.  A prefill-ONLY replica serves just
+            # the first token and exports the prompt KV (two_leg); a
+            # "both" replica picked in a disagg fleet serves colocated
+            # — one leg, no handoff — and a decode leg carries the
+            # rehydrated payload in.  All of this is keyed off the
+            # roles config: a colocated fleet never enters here.
+            two_leg = False
+            budget = request.max_new_tokens
+            if self._disagg and replica.accepts_handoff:
+                if request.handoff is not None:
+                    extra["handoff"] = disagg.rehydrate(
+                        self._host_pool, request.handoff
+                    )
+                elif (
+                    health.get("role")
+                    or getattr(replica, "role", "both")
+                ) == "prefill":
+                    two_leg = True
+                    budget = 1
+                    extra["handoff_export"] = True
+                    # The stream feeds only from the decode leg: the
+                    # prefill leg's first token is re-derived there
+                    # (greedy decode is deterministic), and feeding it
+                    # twice would be harmless-but-wasteful; feeding it
+                    # from a leg that then dies would not be.
+                    extra.pop("on_token", None)
             try:
                 inner = replica.engine.submit(
                     request.prompt,
-                    max_new_tokens=request.max_new_tokens,
+                    max_new_tokens=budget,
                     deadline_s=remaining,
                     **extra,
                 )
@@ -815,10 +900,10 @@ class Fleet:
                 tried.add(replica.id)
                 self._record_failover(request, replica, exc)
                 raise
-            return replica, health, inner
+            return replica, health, inner, two_leg
 
         try:
-            replica, health, inner = self._route_policy.call(
+            replica, health, inner, two_leg = self._route_policy.call(
                 attempt, name="fleet.route", classify=route_transient,
             )
         except BaseException as exc:  # noqa: BLE001 — classified above
@@ -843,6 +928,8 @@ class Fleet:
         }
         if request.priority is not None:
             span_attrs["priority"] = request.priority
+        if two_leg or request.handoff is not None:
+            span_attrs["leg"] = "prefill" if two_leg else "decode"
         occupancy = Replica.occupancy_of(health)
         if occupancy is not None:
             span_attrs["occupancy"] = round(occupancy, 4)
@@ -865,11 +952,18 @@ class Fleet:
         metrics.counter_inc("fleet/routed")
         with self._stats_lock:
             self._routed[replica.id] = self._routed.get(replica.id, 0) + 1
-        inner.add_done_callback(
-            lambda f, req=request, rep=replica: self._on_replica_done(
-                req, rep, f
+        if two_leg:
+            inner.add_done_callback(
+                lambda f, req=request, rep=replica: self._on_prefill_done(
+                    req, rep, f
+                )
             )
-        )
+        else:
+            inner.add_done_callback(
+                lambda f, req=request, rep=replica: self._on_replica_done(
+                    req, rep, f
+                )
+            )
 
     def _record_failover(self, request: _FleetRequest, replica: Replica,
                          exc: BaseException) -> None:
@@ -883,6 +977,57 @@ class Fleet:
         metrics.counter_inc("fleet/failovers")
         with self._stats_lock:
             self._stats["failovers"] += 1
+
+    def _on_prefill_done(self, request: _FleetRequest, replica: Replica,
+                         inner: Future) -> None:
+        """Completion hook for a disaggregated PREFILL leg (runs on the
+        prefill replica's resolving thread): on success the exported KV
+        payload is stashed into the host pool (bytes deduplicated
+        per host) and the request re-enters the fleet queue at the
+        FRONT as a decode leg; any failure classifies exactly like a
+        colocated replica failure — the request re-prefills elsewhere
+        under the same failover budget (``_on_replica_done`` owns that
+        path, and ``request.handoff`` is still None, so the retry IS a
+        fresh prefill)."""
+        if inner.exception() is not None:
+            self._on_replica_done(request, replica, inner)
+            return
+        result = inner.result()
+        payload = (
+            result.handoff if isinstance(result, ServeResult) else None
+        )
+        if payload is None:
+            # Engine served the leg but exported nothing (prefix cache
+            # races are not errors): an EMPTY payload still flips the
+            # request into its decode leg — the decode replica simply
+            # runs a cold prefill.
+            payload = {
+                "version": 1, "block_tokens": 0, "covered_tokens": 0,
+                "keys": [], "payloads": [],
+            }
+        start = time.perf_counter()
+        request.handoff = disagg.stash(self._host_pool, payload)
+        request.prefill_replica = replica.id
+        tracing.record_span(
+            "fleet/handoff", start, time.perf_counter(),
+            **_trace_attrs(request, replica=replica.id,
+                           blocks=disagg.payload_blocks(payload)),
+        )
+        metrics.counter_inc("fleet/handoffs")
+        with self._stats_lock:
+            self._stats["handoffs"] += 1
+        with self._cond:
+            self._in_flight -= 1
+            if self._closed and not self._draining:
+                self._cond.notify_all()
+                self._resolve(request, exc=FleetClosedError(
+                    "fleet closed between prefill and decode legs"
+                ))
+                return
+            # Front of the queue: the request already waited its turn
+            # (same re-entry contract as failover, minus the failure).
+            self._queue.appendleft(request)
+            self._cond.notify_all()
 
     def _on_replica_done(self, request: _FleetRequest, replica: Replica,
                          inner: Future) -> None:
@@ -908,6 +1053,16 @@ class Fleet:
             self._in_flight -= 1
             if requeue and not (self._closed and not self._draining):
                 self._record_failover(request, replica, exc)
+                if request.handoff is not None:
+                    # A dead DECODE leg re-prefills at another prefill
+                    # replica: the seeded blocks died with the decode
+                    # replica's pool, so the payload is void — reset to
+                    # the prefill phase (the frozen trace context rides
+                    # the retry, stitching both passes).
+                    request.handoff = None
+                    metrics.counter_inc("fleet/handoff_failovers")
+                    with self._stats_lock:
+                        self._stats["handoff_failovers"] += 1
                 # Front of the queue: the request already waited its
                 # turn once.
                 self._queue.appendleft(request)
@@ -1102,7 +1257,12 @@ class Fleet:
         with self._cond:
             rid = self._next_replica_id
             self._next_replica_id += 1
-        replica = Replica(rid, self._factory)
+        # Configured roles map by replica id; scale-ups beyond the
+        # tuple default to "both" (they can serve either leg).
+        role = "both"
+        if self._roles is not None and rid < len(self._roles):
+            role = self._roles[rid]
+        replica = Replica(rid, self._factory, role=role)
         with self._cond:
             self._replicas.append(replica)
             self._cond.notify_all()
@@ -1265,6 +1425,13 @@ class Fleet:
             snap["class_completed"] = dict(self._class_completed)
             snap["class_shed"] = dict(self._class_shed)
         snap["replicas"] = self.num_replicas()
+        # Shared host-DRAM prefix pool (zeros when disaggregation is
+        # off — stable schema).
+        snap["host_pool"] = (
+            self._host_pool.stats() if self._host_pool is not None
+            else {"puts": 0, "dedup_hits": 0, "gets": 0, "misses": 0,
+                  "evictions": 0, "blocks": 0}
+        )
         return snap
 
     def dump_timeline(self, path: str) -> str:

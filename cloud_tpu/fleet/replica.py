@@ -39,10 +39,10 @@ from cloud_tpu.utils import faults
 logger = logging.getLogger(__name__)
 
 
-def _submit_accepts_trace(engine: object) -> bool:
-    """True when the engine's ``submit`` takes a ``trace`` kwarg (named
-    or via ``**kwargs``).  Probed once per engine build — never per
-    request — so forwarding a trace context costs routing nothing."""
+def _submit_accepts(engine: object, kwarg: str) -> bool:
+    """True when the engine's ``submit`` takes ``kwarg`` (named or via
+    ``**kwargs``).  Probed once per engine build — never per request —
+    so forwarding the kwarg costs routing nothing."""
     submit = getattr(engine, "submit", None)
     if submit is None:
         return False
@@ -50,18 +50,45 @@ def _submit_accepts_trace(engine: object) -> bool:
         params = inspect.signature(submit).parameters
     except (TypeError, ValueError):  # builtins / exotic callables
         return False
-    return "trace" in params or any(
+    return kwarg in params or any(
         p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
     )
+
+
+def _submit_accepts_trace(engine: object) -> bool:
+    """True when the engine's ``submit`` takes a ``trace`` kwarg."""
+    return _submit_accepts(engine, "trace")
 
 
 class Replica:
     """One slot in the fleet: a stable id, a replaceable engine."""
 
     def __init__(self, replica_id: int, factory: Callable[[], object],
-                 *, start: bool = True):
+                 *, start: bool = True, role: str = "both"):
+        from cloud_tpu.fleet import disagg
+
         self.id = replica_id
         self._factory = factory
+        # Role-aware factories (signature-probed once, same idiom as
+        # the fleet's router-pick probes): a factory declaring a
+        # ``role`` parameter receives the replica's role on every
+        # (re)build, so disaggregated fleets can tune each engine to
+        # its leg — decode replicas pack more concurrent slots (and a
+        # deeper import pool) because they never run prefill.  Zero-arg
+        # factories are untouched, keeping the colocated contract
+        # byte-identical.
+        try:
+            self._factory_takes_role = "role" in inspect.signature(
+                factory
+            ).parameters
+        except (TypeError, ValueError):  # pragma: no cover - exotic
+            self._factory_takes_role = False
+        #: Disaggregated-serving role.  ``"both"`` (the default) keeps
+        #: the colocated fleet byte-identical; ``"prefill"``/``"decode"``
+        #: restrict which request legs the router offers this replica.
+        #: Survives restarts — the role belongs to the replica identity,
+        #: not the engine incarnation.
+        self.role = disagg.validate_role(role)
         self._lock = threading.Lock()
         self.engine: Optional[object] = None
         self.state = "dead"
@@ -79,6 +106,10 @@ class Replica:
         #: the fleet's router-pick probes) — duck-typed fakes predating
         #: the kwarg keep working on the plain path.
         self.accepts_trace = False
+        #: Whether the engine's ``submit()`` accepts the disaggregated
+        #: ``handoff``/``handoff_export`` kwargs (same probe idiom) —
+        #: the fleet only builds handoff legs through replicas that do.
+        self.accepts_handoff = False
         if start:
             self.start()
 
@@ -99,18 +130,25 @@ class Replica:
             self.state = "starting"
         try:
             faults.fault_point("fleet.replica_start")
-            engine = self._factory()
+            if self._factory_takes_role:
+                engine = self._factory(role=self.role)
+            else:
+                engine = self._factory()
         except BaseException:
             with self._lock:
                 self.state = "dead"
             raise
         self.accepts_trace = _submit_accepts_trace(engine)
+        self.accepts_handoff = _submit_accepts(engine, "handoff")
         if hasattr(engine, "set_trace_lane"):
             if self.trace_lane is None:
                 self.trace_lane = tracing.register_lane(
                     f"replica {self.id}"
                 )
             engine.set_trace_lane(self.trace_lane)
+        if self.role != "both" and hasattr(engine, "set_role"):
+            # Restamp fresh incarnations: the role outlives the engine.
+            engine.set_role(self.role)
         with self._lock:
             self.engine = engine
             self.state = "ready"
@@ -178,11 +216,22 @@ class Replica:
                 "prefix_dram_demotions": 0, "prefix_dram_evictions": 0,
                 "prefix_dram_swapin_failures": 0,
                 "cached_prefixes": {},
+                # Disaggregated-serving schema (an engineless replica
+                # still advertises its assigned role; handoff counters
+                # are zero — stable shape next to the prefix keys).
+                "role": self.role,
+                "handoff_exports": 0, "handoff_export_blocks": 0,
+                "handoff_imports": 0, "handoff_import_blocks": 0,
                 "replica": self.id, "state": self.state,
             }
         snap = engine.health()
         snap["replica"] = self.id
         snap["state"] = self.state
+        if "role" not in snap:
+            # Engine-shaped fakes without the disagg schema: stamp the
+            # replica's assigned role so the router's leg filter always
+            # has one spelling to read.
+            snap["role"] = self.role
         return snap
 
     @staticmethod

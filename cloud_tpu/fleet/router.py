@@ -159,10 +159,22 @@ class LeastLoadedRouter:
              exclude: Iterable[int] = (),
              affinity_key: Optional[int] = None,
              priority: Optional[str] = None,
+             role: Optional[str] = None,
              ) -> Tuple[Optional[Replica], Optional[dict]]:
         """Return ``(replica, its health snapshot)`` or ``(None, None)``
         when no routable candidate exists (all excluded, draining,
-        restarting, or unhealthy)."""
+        restarting, or unhealthy).
+
+        ``role`` restricts candidates to replicas serving that
+        disaggregated leg — ``"prefill"`` admits prefill-capable
+        replicas (role ``"prefill"`` or ``"both"``), ``"decode"``
+        decode-capable ones.  ``None`` (the default — and the colocated
+        fleet's only spelling) considers every replica, byte-identical
+        to the pre-disagg contract.  The filter reads the live
+        ``health()`` role (the same snapshot the load signal comes
+        from), falling back to the replica's assigned role."""
+        from cloud_tpu.fleet import disagg
+
         excluded = set(exclude)
         self.last_pick_cached_tokens = 0
         tied: list = []  # (replica, health) rows at the best score
@@ -173,6 +185,14 @@ class LeastLoadedRouter:
             health = replica.health()
             if not replica.routable(health):
                 continue
+            if role is not None:
+                served = health.get("role") or getattr(
+                    replica, "role", "both"
+                )
+                if role == "prefill" and not disagg.serves_prefill(served):
+                    continue
+                if role == "decode" and not disagg.serves_decode(served):
+                    continue
             score = self._score_for(health, priority, affinity_key)
             if best_score is None or score < best_score:
                 tied = [(replica, health)]

@@ -1017,6 +1017,41 @@ def upload_prefix_block(pool, payload, block):
     return out
 
 
+def download_prefix_blocks(pool, blocks):
+    """Batched :func:`download_prefix_block`: gather N pool rows in ONE
+    dispatch.  ``blocks`` is ``[N]`` int32; the result's leaves are
+    stacked ``[N, L, block_tokens, H, hd]`` — the caller unstacks into
+    per-block payloads host-side.  Out-of-range indices clip (callers
+    padding to a shape bucket discard those rows), and like the
+    batched upload this turns a long KV-handoff export from N
+    dynamic-slice dispatches into one gather."""
+    blocks = jnp.asarray(blocks, jnp.int32)
+    out = {}
+    for name, leaf in pool.items():
+        rows = jnp.take(leaf, blocks, axis=1, mode="clip")
+        out[name] = jnp.moveaxis(rows, 1, 0)  # [N, L, bt, H, hd]
+    return out
+
+
+def upload_prefix_blocks(pool, payloads, blocks):
+    """Batched :func:`upload_prefix_block`: write N host payloads into
+    N pool rows in ONE dispatch.  ``payloads`` leaves are stacked
+    ``[N, L, block_tokens, H, hd]``; ``blocks`` is ``[N]`` int32.  An
+    out-of-range block index is dropped (``mode="drop"``), so callers
+    can pad a partial batch to a fixed shape bucket with
+    ``num_blocks`` sentinels instead of compiling one executable per
+    batch size.  The KV-handoff import seam uses this: a long exported
+    prefix is dozens of blocks, and one scatter beats dozens of
+    single-row dynamic updates by the whole per-dispatch overhead."""
+    blocks = jnp.asarray(blocks, jnp.int32)
+    out = dict(pool)
+    for name, leaf in pool.items():
+        stacked = jnp.asarray(payloads[name]).astype(leaf.dtype)
+        rows = jnp.moveaxis(stacked, 0, 1)  # [L, N, bt, H, hd]
+        out[name] = leaf.at[:, blocks].set(rows, mode="drop")
+    return out
+
+
 def prefill_chunk_program(
     params,
     cache,

@@ -581,6 +581,12 @@ class TraceReport:
           (via the slot map); ``spec_accepted`` — draft tokens the
           verify dispatches it participated in committed (batch-level:
           a shared verify credits every rider).
+        * ``handoff_s`` / ``handoffs`` — disaggregated-serving KV
+          transport time (``serve/kv_handoff`` export/import dispatches
+          plus the fleet's ``fleet/handoff`` stash) and the number of
+          prefill->decode handoffs; ``prefill_leg_s`` — the prefill
+          leg's full service time (its non-final ``serve/request``
+          terminals).  All zero on colocated timelines.
         * ``shed`` — the request hit a shed span; ``complete`` — a
           terminal ``serve/request`` span exists.
 
@@ -635,6 +641,12 @@ class TraceReport:
                     1 for e in spans if e["name"] == "serve/chunk"
                 ),
                 "spec_accepted": spec_accepted,
+                "handoff_s": total_of("serve/kv_handoff",
+                                      "fleet/handoff"),
+                "handoffs": sum(
+                    1 for e in spans if e["name"] == "fleet/handoff"
+                ),
+                "prefill_leg_s": 0.0,
                 "shed": any(
                     e["name"] in ("serve/shed", "fleet/shed")
                     for e in spans
@@ -651,11 +663,23 @@ class TraceReport:
                 terminal = max(terminals, key=lambda e: e["ts"])
                 args = terminal.get("args") or {}
                 row["latency_s"] = terminal["dur"] / 1e6
+                if row["handoffs"]:
+                    # Disaggregated request: the earlier terminals are
+                    # its prefill leg(s) — service time the decode
+                    # leg's own TTFT never saw.  Colocated rows (no
+                    # handoff spans) keep this at exactly 0.0 even
+                    # across failover re-runs, whose earlier terminals
+                    # are retries, not legs.
+                    row["prefill_leg_s"] = sum(
+                        e["dur"] / 1e6 for e in terminals
+                        if e is not terminal
+                    )
                 ttft = args.get("ttft_s")
                 if isinstance(ttft, (int, float)):
                     row["ttft_s"] = float(ttft)
                     row["fleet_ttft_s"] = (
                         float(ttft) + (queue_s or 0.0) + row["route_s"]
+                        + row["prefill_leg_s"]
                     )
                 tokens = args.get("tokens")
                 if isinstance(tokens, (int, float)):
@@ -667,7 +691,7 @@ class TraceReport:
     #: order; first_decode is the remainder after the attributable
     #: phases).
     TTFT_COMPONENTS = (
-        "queue", "route", "swapin", "prefill", "first_decode",
+        "queue", "route", "swapin", "prefill", "handoff", "first_decode",
     )
 
     def ttft_decomposition(
@@ -682,8 +706,16 @@ class TraceReport:
         * ``route`` — routing decisions (all attempts),
         * ``swapin`` — host-DRAM prefix swap-in stalls,
         * ``prefill`` — prefill compute,
+        * ``handoff`` — disaggregated KV transport (export/import
+          dispatches plus the host-pool stash; 0 on colocated
+          timelines, whose totals are unchanged),
         * ``first_decode`` — the remainder (scheduler slack + the first
           decode step), clamped at zero.
+
+        Disaggregated requests count their prefill leg's service time
+        (``prefill_leg_s``) inside the total: fleet TTFT is the time
+        the CALLER waited for the first decode-leg token, wherever the
+        work ran.
 
         Returns per-component **shares** of fleet TTFT at p50/p99
         across requests, plus the fleet-TTFT percentiles themselves —
@@ -706,7 +738,10 @@ class TraceReport:
                 continue
             queue = (row["queue_s"] or 0.0) + row["engine_queue_s"]
             route = row["route_s"]
-            total = (row["queue_s"] or 0.0) + route + row["ttft_s"]
+            total = (
+                (row["queue_s"] or 0.0) + route
+                + row.get("prefill_leg_s", 0.0) + row["ttft_s"]
+            )
             if total <= 0:
                 continue
             components = {
@@ -714,6 +749,7 @@ class TraceReport:
                 "route": route,
                 "swapin": row["swapin_s"],
                 "prefill": row["prefill_s"],
+                "handoff": row.get("handoff_s", 0.0),
             }
             components["first_decode"] = max(
                 total - sum(components.values()), 0.0

@@ -332,6 +332,24 @@ class ServeConfig:
     #: (docs/KERNELS.md).  Greedy outputs are token-identical on every
     #: setting.  Continuous-scheduler only.
     decode_kernel: str = "xla"
+    #: Disaggregated-serving role this engine plays in a fleet:
+    #: ``"prefill"`` (serves the prefill leg of split requests),
+    #: ``"decode"`` (serves handoff-carrying decode legs), or
+    #: ``"both"`` (default — the colocated engine, byte-identical to
+    #: today; the ``role``/handoff health keys read ``"both"``/zero).
+    #: Routing policy lives in the fleet; the engine only reports the
+    #: role and accepts the handoff submit kwargs, which themselves
+    #: need the continuous scheduler plus a prefix pool (the handoff IS
+    #: cross-replica prefix-cache seeding — docs/fleet.md).  A fleet
+    #: replica may override per-replica via :meth:`ServingEngine.
+    #: set_role`, so one factory serves mixed-role fleets.
+    role: str = "both"
+    #: TTL (seconds) on the router-facing ``hot_prefixes()`` summary:
+    #: entries for prefixes not HIT within it age out of ``health()``'s
+    #: ``cached_prefixes``, so a replica that lost its hot tenant stops
+    #: advertising stale cached-prefix credit to the cost-model router.
+    #: ``None`` (default) never expires — byte-identical to today.
+    prefix_summary_ttl_s: Optional[float] = None
 
     def __post_init__(self):
         from cloud_tpu.models.generation import SampleConfig
@@ -453,6 +471,25 @@ class ServeConfig:
                 f"decode_kernel must be 'auto', 'pallas', or 'xla', "
                 f"got {self.decode_kernel!r}"
             )
+        if self.role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode', or 'both', "
+                f"got {self.role!r}"
+            )
+        if self.role != "both" and (
+            self.scheduler != "continuous" or not self.prefix_cache_blocks
+        ):
+            raise ValueError(
+                "role= (disaggregated serving) needs the continuous "
+                "scheduler and prefix_cache_blocks > 0 — the KV handoff "
+                "exports/imports prefix-pool blocks"
+            )
+        if (self.prefix_summary_ttl_s is not None
+                and self.prefix_summary_ttl_s <= 0):
+            raise ValueError(
+                f"prefix_summary_ttl_s must be > 0 or None, got "
+                f"{self.prefix_summary_ttl_s}"
+            )
         if self.decode_kernel != "xla" and self.scheduler != "continuous":
             raise ValueError(
                 "decode_kernel= (paged decode attention) needs the "
@@ -510,6 +547,12 @@ class ServeResult:
     #: ``dataclasses.replace`` untouched, so the fleet's latency rebase
     #: on failover keeps the identity.
     trace_id: Optional[str] = None
+    #: KV handoff payload exported for this request (disaggregated
+    #: serving: ``submit(handoff_export=True)`` on a prefill replica) —
+    #: the prompt's cached prefix blocks serialized host-side, dict
+    #: shape per ``fleet.disagg``.  None everywhere else (the default
+    #: fleet never builds one — pinned byte-identical).
+    handoff: Optional[dict] = None
 
 
 #: eq=False: requests are removed from mid-queue by IDENTITY (QoS
@@ -541,6 +584,13 @@ class _Request:
     #: while tracing is off, so the disabled span set stays
     #: byte-identical.
     trace: Optional[tracing.TraceContext] = None
+    #: Disaggregated prefill leg: export the prompt's cached prefix
+    #: blocks host-side after prefill (``ServeResult.handoff``).
+    handoff_export: bool = False
+    #: Disaggregated decode leg: a handoff payload to seed the prefix
+    #: cache with BEFORE this request's own prefix lookup, so admission
+    #: sees an ordinary hit.  None on every non-handoff request.
+    handoff: Optional[dict] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -578,6 +628,10 @@ class _Slot:
     #: Tokens already delivered to the request's stream/on_token hook
     #: (prefix of ``tokens``, capped at the request's budget).
     streamed: int = 0
+    #: Exported KV handoff payload (``handoff_export`` requests only):
+    #: built right after the prefix save, carried to ``_retire_slot``
+    #: which rides it out on the result.
+    handoff: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -644,95 +698,39 @@ class _Cell:
         )
 
 
-class _BurstDispatcher:
-    """ONE supervised worker thread for a burst of dispatches.
+class _DeferredPayload:
+    """A demoted block's host bytes, not yet downloaded.
 
-    ``_supervised`` pays a fresh watchdog thread per dispatch — right
-    for isolated chunk/prefill programs, wasteful for a demotion burst
-    where a single allocation can evict dozens of blocks back-to-back
-    (the swap-in path already batches its whole plan under one
-    watchdog).  The burst dispatcher starts its worker lazily on the
-    first call, runs each closure serially on that worker, and applies
-    the engine's full per-dispatch watchdog contract per call — same
-    ``dispatch_timeout_s`` budget, orphan tracking, unhealthy-reason
-    latch, and :class:`DispatchTimeoutError` as ``_supervised``.  The
-    caller still blocks until each closure returns, so demote downloads
-    stay strictly ordered BEFORE the row reuse that follows them.
-    Scheduler-thread only, like the dispatch path it serves.
+    Inside a demotion burst (``_demote_burst``), ``_demote_block``
+    returns one of these instead of paying a supervised download per
+    evicted block; the burst's exit flushes ALL pending downloads as
+    one batched dispatch under ONE watchdog window
+    (``_flush_demotes``), mirroring how the swap-in side budgets a
+    whole plan.  Safe because nothing materializes a demoted payload
+    until after the burst scope closes: the save/swap-in programs that
+    reuse the evicted rows dispatch strictly AFTER the manager call the
+    burst wraps, and ``_dispatch_swapin`` resolves placeholders via
+    ``_resolve_payload`` at upload time.  Scheduler-thread only.
     """
 
-    def __init__(self, engine: "ServingEngine"):
-        self._engine = engine
-        self._cond = threading.Condition()
-        self._item = None          # (fn, box, done) awaiting the worker
-        self._stopped = False
-        self._thread: Optional[threading.Thread] = None
-        self._timed_out = False
+    __slots__ = ("value", "filled")
 
-    def _worker(self) -> None:
-        while True:
-            with self._cond:
-                while self._item is None and not self._stopped:
-                    self._cond.wait()
-                if self._item is None:
-                    return
-                fn, box, done = self._item
-                self._item = None
-            try:
-                box["result"] = fn()
-            except BaseException as exc:  # noqa: BLE001 — rethrown below
-                box["error"] = exc
-            finally:
-                done.set()
+    def __init__(self):
+        self.value = None
+        self.filled = False
 
-    def call(self, label: str, fn):
-        """Run ``fn`` under the shared worker with ``_supervised``'s
-        exact watchdog semantics (one budget per call)."""
-        engine = self._engine
-        timeout = engine.serve_config.dispatch_timeout_s
-        engine._last_dispatch_ts = time.perf_counter()
-        if timeout is None:
-            return fn()
-        if self._timed_out:
-            # The worker is wedged on an earlier dispatch of this same
-            # burst; queueing behind it could only hang again.  The
-            # first timeout already latched the engine unhealthy.
-            raise DispatchTimeoutError(
-                f"{label} skipped: burst dispatcher already timed out"
-            )
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._worker, daemon=True,
-                name=SERVE_DISPATCH_THREAD_NAME,
-            )
-            self._thread.start()
-        box: dict = {}
-        done = threading.Event()
-        with self._cond:
-            self._item = (fn, box, done)
-            self._cond.notify_all()
-        if not done.wait(timeout):
-            self._timed_out = True
-            engine._orphan_dispatches.append(self._thread)
-            engine._unhealthy_reason = (
-                f"{label} exceeded dispatch_timeout_s={timeout}"
-            )
-            metrics.counter_inc("serve/watchdog_timeouts")
-            with engine._stats_lock:
-                engine._stats["watchdog_timeouts"] += 1
-            raise DispatchTimeoutError(engine._unhealthy_reason)
-        if "error" in box:
-            raise box["error"]
-        return box["result"]
 
-    def shutdown(self) -> None:
-        """End the burst: stop and join the worker (unless it is wedged,
-        in which case it is already orphan-tracked for ``close()``)."""
-        with self._cond:
-            self._stopped = True
-            self._cond.notify_all()
-        if self._thread is not None and not self._timed_out:
-            self._thread.join()
+def _resolve_payload(payload):
+    """A demoted block's actual host bytes (unwraps a burst-deferred
+    placeholder; anything else passes through)."""
+    if isinstance(payload, _DeferredPayload):
+        if not payload.filled:
+            raise RuntimeError(
+                "deferred demote payload read before its burst flushed "
+                "— demote downloads must complete before row reuse"
+            )
+        return payload.value
+    return payload
 
 
 class ServingEngine:
@@ -800,11 +798,17 @@ class ServingEngine:
         #: scheduler stamps its spans with; None = the real process pid.
         #: Set by the owning fleet replica via :meth:`set_trace_lane`.
         self._trace_lane: Optional[int] = None
-        #: Live demotion-burst dispatcher (satellite of ISSUE 16): while
-        #: a prefix-cache insert/swap-in reservation runs, demote
-        #: downloads share ONE supervised worker instead of paying a
-        #: watchdog thread per block.  Scheduler-thread only.
-        self._demote_dispatcher: Optional[_BurstDispatcher] = None
+        #: Live demotion burst: while a prefix-cache insert/swap-in
+        #: reservation runs, demote downloads are DEFERRED into this
+        #: list and flushed as one batched dispatch under ONE watchdog
+        #: window at burst exit (``_flush_demotes``) — mirroring how
+        #: the swap-in side budgets a whole plan, instead of paying a
+        #: supervised thread per evicted block.  Scheduler-thread only.
+        self._demote_batch: Optional[List[tuple]] = None
+        #: This engine's disaggregated-serving role (``"both"`` keeps
+        #: the colocated default).  Plain str swap — the owning fleet
+        #: replica may restamp it via :meth:`set_role`.
+        self._role = self.serve_config.role
         #: Rows of the batch currently on the device (batch scheduler;
         #: the continuous path reads its slot table instead).  Plain int
         #: swap — written by the scheduler, read by ``health()``.
@@ -839,6 +843,10 @@ class ServingEngine:
             "traced": 0,
             # QoS brownout sheds (0 unless qos arms a brownout depth).
             "brownout_shed": 0,
+            # Disaggregated-serving KV handoff counters (all 0 with
+            # role="both" and no handoff submits — stable schema).
+            "handoff_exports": 0, "handoff_export_blocks": 0,
+            "handoff_imports": 0, "handoff_import_blocks": 0,
         }
         #: QoS state: None keeps the FIFO path byte-identical (every
         #: policy branch below checks this).  The scheduler object owns
@@ -927,6 +935,7 @@ class ServingEngine:
                         self._demote_block if cfg.prefix_dram_blocks
                         else None
                     ),
+                    summary_ttl_s=cfg.prefix_summary_ttl_s,
                 )
 
                 def make_pool():
@@ -943,6 +952,25 @@ class ServingEngine:
                     jax.jit(make_pool)() if self._slice_chips > 1
                     else make_pool()
                 )
+            # Engine device-state lives WITH the params: the init
+            # programs above land on the process default device, so on
+            # multi-device hosts (a fleet pinning one replica's params
+            # per device) the grid, slot state, and pool must be
+            # re-committed to the params' device or the first dispatch
+            # raises on mixed committed placements.
+            if self.mesh is None:
+                device = self._params_device()
+                if device is not None:
+                    self._grid_cache = jax.device_put(
+                        self._grid_cache, device
+                    )
+                    self._slot_state = jax.device_put(
+                        self._slot_state, device
+                    )
+                    if self._prefix_pool is not None:
+                        self._prefix_pool = jax.device_put(
+                            self._prefix_pool, device
+                        )
             #: Paged decode attention (``decode_kernel != "xla"``): the
             #: slot grid's attention reads KV through a per-slot block
             #: table — page p of a row resolves to a prefix-pool block
@@ -978,6 +1006,10 @@ class ServingEngine:
             #: each — block index and payload shapes are static).
             self._download_step = None
             self._swapin_step = None
+            self._upload_traces = 0
+            self._upload_step = None
+            self._export_traces = 0
+            self._export_step = None
             self._draft_traces = 0
             self._verify_traces = 0
             self._draft_prefill_traces = 0
@@ -1316,6 +1348,27 @@ class ServingEngine:
         Thread-safe (int swap); the scheduler re-reads it every pass."""
         self._trace_lane = lane
 
+    def set_role(self, role: str) -> None:
+        """Adopt a disaggregated-serving role (``"prefill"``,
+        ``"decode"``, or ``"both"``): advertised through ``health()``/
+        ``stats()`` so the fleet router can steer legs, and validated
+        against the same scheduler requirements as the ctor knob.
+        Duck-typed like :meth:`set_trace_lane` — the fleet replica
+        calls it via ``hasattr``.  Thread-safe (str swap)."""
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'both', got {role!r}"
+            )
+        if role != "both" and (
+                not self._continuous
+                or not self.serve_config.prefix_cache_blocks):
+            raise ValueError(
+                "role= (disaggregated serving) needs the continuous "
+                "scheduler and prefix_cache_blocks > 0 — the KV handoff "
+                "exports/imports prefix-pool blocks"
+            )
+        self._role = role
+
     def start(self) -> "ServingEngine":
         """Launch the scheduler thread (idempotent)."""
         with self._cond:
@@ -1386,7 +1439,9 @@ class ServingEngine:
                priority: Optional[str] = None,
                stream: bool = False,
                on_token=None,
-               trace: Optional[tracing.TraceContext] = None) -> Future:
+               trace: Optional[tracing.TraceContext] = None,
+               handoff_export: bool = False,
+               handoff: Optional[dict] = None) -> Future:
         """Enqueue one prompt; returns a Future of :class:`ServeResult`
         (or a :class:`~cloud_tpu.serving.qos.TokenStream` with
         ``stream=True``).
@@ -1426,10 +1481,30 @@ class ServingEngine:
         result reports it).  Inert while tracing is disabled; None (the
         default) keeps the engine's span set byte-identical to the
         pre-tracing behavior.
+
+        ``handoff_export=True`` marks the request as a disaggregated
+        PREFILL leg: right after its prompt blocks land in the prefix
+        pool the engine downloads them host-side and rides the payload
+        out on ``ServeResult.handoff`` for a decode replica to import.
+        ``handoff=<payload>`` marks the DECODE leg: the payload's
+        blocks are seeded into this engine's prefix trie before
+        admission, so the request's normal prefix lookup hits them
+        (ATTACH when paged, copy program otherwise) and decode runs
+        token-identical to a colocated ``generate()``.  Both require
+        the continuous scheduler with a prefix cache; both default off
+        — the engine stays byte-identical without them.
         """
         cfg = self.serve_config
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if (handoff_export or handoff is not None) and (
+                not self._continuous
+                or getattr(self, "_prefix", None) is None):
+            raise ValueError(
+                "handoff_export/handoff need the continuous scheduler "
+                "and prefix_cache_blocks > 0 — the KV handoff moves "
+                "prefix-pool blocks"
+            )
         if self._qos is not None:
             priority = self._qos.resolve_priority(priority)
         else:
@@ -1463,6 +1538,7 @@ class ServingEngine:
             ),
             priority=priority, stream=token_stream, on_token=on_token,
             trace=trace,
+            handoff_export=handoff_export, handoff=handoff,
         )
         if token_stream is not None:
             token_stream.trace_id = request.trace_id
@@ -1730,16 +1806,116 @@ class ServingEngine:
             )
         return self._swapin_step
 
+    def _upload_cell(self):
+        """Batched pool-row upload for the KV-handoff import seam (jit
+        recompiles per padded batch-size bucket; AotStep's fallback
+        handles the shape churn)."""
+        if self._upload_step is None:
+            import jax
+
+            from cloud_tpu.models import generation
+            from cloud_tpu.training import compile_cache
+
+            def upload_fn(pool, payloads, blocks):
+                self._upload_traces += 1
+                return generation.upload_prefix_blocks(
+                    pool, payloads, blocks
+                )
+
+            donate = (0,) if self._donate else ()
+            self._upload_step = compile_cache.AotStep(
+                jax.jit(upload_fn, donate_argnums=donate),
+                label="serve/kv_handoff",
+            )
+        return self._upload_step
+
+    def _handoff_batch_blocks(self) -> int:
+        """The FIXED batch size every handoff gather/scatter pads to —
+        the longest exportable chain the config admits (capped by pool
+        capacity).  One shape means ONE executable for every import
+        and export, compiled by the first handoff (e.g. a warm-up
+        request) instead of a fresh multi-second compile stalling the
+        scheduler thread — and every active decode slot with it — the
+        first time a dedup'd or truncated payload shows up with a new
+        block count."""
+        cfg = self.serve_config
+        bound = cfg.prefix_cache_blocks
+        if cfg.prompt_buckets:
+            bound = min(
+                bound,
+                max(cfg.prompt_buckets) // cfg.prefix_block_tokens,
+            )
+        return max(1, bound)
+
+    def _params_device(self):
+        """The single device the params are committed to (None when
+        sharded across several, or on exotic leaves) — the placement
+        every piece of engine device-state follows."""
+        import jax
+
+        try:
+            leaf = jax.tree_util.tree_leaves(self.params)[0]
+            devices = leaf.devices()
+            if len(devices) == 1:
+                return next(iter(devices))
+        except Exception:  # pragma: no cover - exotic param leaves
+            pass
+        return None
+
+    def _pool_device(self):
+        """The device the prefix pool is committed to (None when the
+        pool is sharded or unallocated — device_put then falls back to
+        its default placement).  Host-side payload uploads target this
+        so a fleet of replicas pinned to distinct host devices never
+        mixes a default-device payload into another device's pool."""
+        try:
+            leaf = next(iter(self._prefix_pool.values()))
+            devices = leaf.devices()
+            if len(devices) == 1:
+                return next(iter(devices))
+        except Exception:  # pragma: no cover - sharded/exotic pools
+            pass
+        return None
+
+    def _export_cell(self):
+        """Batched pool-row download for the KV-handoff export seam
+        (pool is read, not donated — the rows stay live for serving)."""
+        if self._export_step is None:
+            import jax
+
+            from cloud_tpu.models import generation
+            from cloud_tpu.training import compile_cache
+
+            def export_fn(pool, blocks):
+                self._export_traces += 1
+                return generation.download_prefix_blocks(pool, blocks)
+
+            self._export_step = compile_cache.AotStep(
+                jax.jit(export_fn), label="serve/kv_handoff",
+            )
+        return self._export_step
+
     def _demote_block(self, block: int):
         """The manager's ``demote_fn``: capture one HBM pool row's bytes
         host-side (numpy, outside jit) before the row is reused.  Runs
         on the scheduler thread during allocation, strictly BEFORE the
         save/swap-in dispatch that overwrites the row, so the bytes are
-        exactly what the trie says they are.  The download (and its
+        exactly what the trie says they are.  Inside a burst
+        (``_demote_burst``) the download is DEFERRED: the trie keeps a
+        :class:`_DeferredPayload` placeholder and the burst's exit
+        flushes every pending download as ONE supervised dispatch —
+        one watchdog thread per burst, mirroring how the swap-in side
+        budgets a whole plan.  Outside a burst the download (and its
         blocking device->host sync) runs under the watchdog like every
         other dispatch: a wedged device fails typed instead of hanging
         the scheduler on ``np.asarray`` forever."""
         import jax
+
+        if self._demote_batch is not None:
+            deferred = _DeferredPayload()
+            self._demote_batch.append((int(block), deferred))
+            metrics.counter_inc("serve/prefix_demotions")
+            return deferred
 
         cell = self._download_cell()
 
@@ -1748,35 +1924,49 @@ class ServingEngine:
             return jax.tree_util.tree_map(np.asarray, payload)
 
         with tracing.span("serve/prefix_demote", block=int(block)):
-            if self._demote_dispatcher is not None:
-                payload = self._demote_dispatcher.call(
-                    "serve/prefix_demote", dispatch
-                )
-            else:
-                payload = self._supervised("serve/prefix_demote", dispatch)
+            payload = self._supervised("serve/prefix_demote", dispatch)
         metrics.counter_inc("serve/prefix_demotions")
         return payload
 
     @contextlib.contextmanager
     def _demote_burst(self):
         """Scope one prefix-cache allocation burst: every
-        ``_demote_block`` inside shares ONE supervised worker thread
-        (one watchdog dispatch thread per burst, mirroring how
-        ``_dispatch_swapin`` budgets a whole plan) instead of paying a
-        fresh thread per evicted block.  No-op when the watchdog is
-        disabled (``dispatch_timeout_s=None`` runs inline anyway) or
-        when already inside a burst."""
-        if (self.serve_config.dispatch_timeout_s is None
-                or self._demote_dispatcher is not None):
+        ``_demote_block`` inside defers its download into one batch,
+        flushed at scope exit as ONE supervised dispatch (one watchdog
+        thread per burst, mirroring how ``_dispatch_swapin`` budgets a
+        whole plan) instead of paying a fresh thread per evicted block.
+        Safe because the save/swap-in programs that reuse the evicted
+        rows dispatch strictly AFTER this scope closes.  No-op when
+        already inside a burst."""
+        if self._demote_batch is not None:
             yield
             return
-        burst = _BurstDispatcher(self)
-        self._demote_dispatcher = burst
+        batch: List[tuple] = []
+        self._demote_batch = batch
         try:
             yield
         finally:
-            self._demote_dispatcher = None
-            burst.shutdown()
+            self._demote_batch = None
+            if batch:
+                self._flush_demotes(batch)
+
+    def _flush_demotes(self, batch: List[tuple]) -> None:
+        """Download a burst's deferred demotions under ONE supervised
+        dispatch, filling their placeholders — strictly before any row
+        reuse (the caller's scope exits before the save/swap-in that
+        overwrites the rows is dispatched)."""
+        import jax
+
+        cell = self._download_cell()
+
+        def dispatch():
+            for block, deferred in batch:
+                payload = cell(self._prefix_pool, np.int32(block))
+                deferred.value = jax.tree_util.tree_map(np.asarray, payload)
+                deferred.filled = True
+
+        with tracing.span("serve/prefix_demote", blocks=len(batch)):
+            self._supervised("serve/prefix_demote", dispatch)
 
     def _dispatch_swapin(self, slot: int, plan,
                          trace_id: Optional[str] = None) -> None:
@@ -1796,8 +1986,11 @@ class ServingEngine:
             # thread, not one per block); still one executable, one
             # upload dispatch per block.
             pool = self._prefix_pool
+            device = self._pool_device()
             for _node, block, payload in plan:
-                pool = cell(pool, jax.device_put(payload),
+                pool = cell(pool,
+                            jax.device_put(_resolve_payload(payload),
+                                           device),
                             np.int32(block))
             return pool
 
@@ -2412,6 +2605,12 @@ class ServingEngine:
             # Fresh claim: every page reads the slot row until a hit
             # attaches pool blocks below.
             self._block_table[slot, :] = -1
+        # Disaggregated decode leg: seed the handoff payload's blocks
+        # into the trie FIRST, so the ordinary lookup below hits them.
+        # The seed refs are dropped once the acquire has its own pins.
+        seed_held: List[object] = []
+        if request.handoff is not None and self._prefix is not None:
+            seed_held = self._import_handoff(request)
         hit = None
         held: List[object] = []
         swapin_plan = None
@@ -2454,6 +2653,10 @@ class ServingEngine:
                 metrics.counter_inc("serve/prefix_misses")
                 with self._stats_lock:
                     self._stats["prefix_misses"] += 1
+        if seed_held:
+            # The acquire above pinned what it needs; the seed's
+            # bridging references have done their job.
+            self._prefix.release(seed_held)
         if hit is None and not use_chunks:
             self._insert_request(request, slot)
             return
@@ -2600,6 +2803,7 @@ class ServingEngine:
         entry.first_token_ts = time.perf_counter()
         self._feed_entry(entry)
         self._save_prefix_blocks(request, slot, already=task.hit)
+        self._export_handoff(request, slot)
         self._activate_or_retire(slot, request, tok0)
 
     def _save_prefix_blocks(self, request: _Request, slot: int,
@@ -2644,6 +2848,156 @@ class ServingEngine:
                 "serve/prefix_save", dispatch
             )
         metrics.counter_inc("serve/prefix_saved_blocks", len(created))
+
+    def _export_handoff(self, request: _Request, slot: int) -> None:
+        """Build a disaggregated-serving handoff payload from a
+        just-prefilled slot's prefix-pool blocks (no-op unless the
+        request asked via ``handoff_export`` and a prefix cache is
+        armed).  Runs right after ``_save_prefix_blocks`` — the slot's
+        ``prefix_nodes`` is the prompt's full root-down block chain,
+        ref-pinned until retire, so the rows are immutable while the
+        batched download (ONE supervised dispatch, like the demote
+        flush) captures them via ``download_prefix_block`` — per-leaf
+        numpy pytrees, the DRAM tier's exact serialization, so kv_quant
+        int8 blocks and their scale leaves ride verbatim.  The payload
+        parks on the slot and rides out on ``ServeResult.handoff``."""
+        if not request.handoff_export or self._prefix is None:
+            return
+        import jax
+
+        cfg = self.serve_config
+        entry = self._slot_table[slot]
+        nodes = list(entry.prefix_nodes)
+        payload = {
+            "version": 1,
+            "block_tokens": cfg.prefix_block_tokens,
+            "covered_tokens": len(nodes) * cfg.prefix_block_tokens,
+            "keys": [tuple(node.key) for node in nodes],
+            "payloads": [],
+        }
+        if nodes:
+            cell = self._export_cell()
+            blocks = [int(node.block) for node in nodes]
+            # One gather for the whole chain, padded to the config's
+            # fixed batch size (clipped pad rows are discarded below)
+            # so every export reuses one executable.
+            n = len(blocks)
+            bucket = max(self._handoff_batch_blocks(), n)
+            block_ids = np.asarray(
+                blocks + [0] * (bucket - n), np.int32
+            )
+
+            def dispatch():
+                host = jax.tree_util.tree_map(
+                    np.asarray, cell(self._prefix_pool, block_ids)
+                )
+                # Per-block copies: a payload must not pin the whole
+                # stacked gather in host memory once the pool/trie
+                # dedups it down to a few blocks.
+                return [
+                    {name: leaf[i].copy() for name, leaf in host.items()}
+                    for i in range(n)
+                ]
+
+            with tracing.span(
+                "serve/kv_handoff",
+                **_trace_attrs(request, direction="export", slot=slot,
+                               blocks=len(nodes)),
+            ):
+                payload["payloads"] = self._supervised(
+                    "serve/kv_handoff", dispatch
+                )
+        entry.handoff = payload
+        with self._stats_lock:
+            self._stats["handoff_exports"] += 1
+            self._stats["handoff_export_blocks"] += len(nodes)
+        metrics.counter_inc("serve/handoff_exports")
+        metrics.counter_inc("serve/handoff_export_blocks", len(nodes))
+
+    def _import_handoff(self, request: _Request) -> List[object]:
+        """Seed this engine's prefix trie with a handoff payload's
+        blocks, so the request's ordinary admission lookup (just below
+        in ``_admit_request``) sees a plain prefix hit — ATTACH when
+        paged, the copy program otherwise.  Uploads only the blocks the
+        trie did NOT already hold (the cross-replica dedup), batched
+        under ONE supervised dispatch.  Returns the seeded nodes, each
+        carrying one reference the caller drops once its own acquire
+        has pinned the hit.  Malformed/partial payloads import less —
+        the suffix prefill covers the rest, never a correctness
+        dependency."""
+        import jax
+
+        cfg = self.serve_config
+        payload = request.handoff
+        if int(payload.get("block_tokens") or 0) != cfg.prefix_block_tokens:
+            return []
+        keys = list(payload.get("keys") or ())
+        payloads = list(payload.get("payloads") or ())
+        usable = 0
+        for i, key in enumerate(keys):
+            if (i < len(payloads) and payloads[i] is not None
+                    and len(key) == cfg.prefix_block_tokens):
+                usable += 1
+            else:
+                break
+        if not usable:
+            return []
+        with tracing.span(
+            "serve/kv_handoff",
+            **_trace_attrs(request, direction="import", blocks=usable),
+        ) as span:
+            with self._demote_burst():
+                held, created = self._prefix.seed_blocks(keys[:usable])
+            span.set_attribute("seeded", len(held))
+            span.set_attribute("uploaded", len(created))
+            if created:
+                cell = self._upload_cell()
+                created_ids = {id(node) for node in created}
+                uploads = [
+                    (int(node.block), payloads[i])
+                    for i, node in enumerate(held)
+                    if id(node) in created_ids
+                ]
+                # One scatter for the whole batch, padded to the
+                # config's fixed batch size so every import reuses one
+                # executable; pad rows carry an out-of-range block
+                # index and are dropped in-program.
+                n = len(uploads)
+                bucket = max(self._handoff_batch_blocks(), n)
+                pad = bucket - n
+                drop = self.serve_config.prefix_cache_blocks
+                block_ids = np.asarray(
+                    [b for b, _ in uploads] + [drop] * pad, np.int32
+                )
+                stacked = {}
+                for name in uploads[0][1]:
+                    arr = np.stack([p[name] for _, p in uploads])
+                    if pad:
+                        arr = np.concatenate([
+                            arr,
+                            np.zeros((pad,) + arr.shape[1:], arr.dtype),
+                        ])
+                    stacked[name] = arr
+
+                def dispatch():
+                    # Upload to the pool's own device: on multi-device
+                    # hosts (one virtual device per replica) a bare
+                    # device_put would land on the process default
+                    # device and conflict with the committed pool.
+                    return cell(self._prefix_pool,
+                                jax.device_put(stacked,
+                                               self._pool_device()),
+                                block_ids)
+
+                self._prefix_pool = self._supervised(
+                    "serve/kv_handoff", dispatch
+                )
+        with self._stats_lock:
+            self._stats["handoff_imports"] += 1
+            self._stats["handoff_import_blocks"] += len(held)
+        metrics.counter_inc("serve/handoff_imports")
+        metrics.counter_inc("serve/handoff_import_blocks", len(held))
+        return held
 
     def _activate_or_retire(self, slot: int, request: _Request,
                             tok0: int) -> None:
@@ -2702,6 +3056,7 @@ class ServingEngine:
         self._slot_table[slot] = entry
         self._feed_entry(entry)
         self._save_prefix_blocks(request, slot)
+        self._export_handoff(request, slot)
         self._activate_or_retire(slot, request, tok0)
 
     def _active_trace_map(self) -> Optional[Dict[str, str]]:
@@ -2958,6 +3313,7 @@ class ServingEngine:
             latency_seconds=done - request.submitted,
             ttft_seconds=first - request.submitted,
             trace_id=request.trace_id,
+            handoff=entry.handoff,
         )
         metrics.distribution_record(
             "serve/latency_seconds", result.latency_seconds
@@ -3187,7 +3543,20 @@ class ServingEngine:
             # The armed decode-attention path ("xla" default; stable
             # schema — the batch scheduler only ever reports "xla").
             "decode_kernel": self.serve_config.decode_kernel,
+            # Disaggregated serving (stable schema — "both" and zeros
+            # with roles off): the role the fleet router steers legs
+            # by, plus the KV handoff counters.
+            "role": self._role,
         }
+        with self._stats_lock:
+            snap["handoff_exports"] = self._stats["handoff_exports"]
+            snap["handoff_export_blocks"] = (
+                self._stats["handoff_export_blocks"]
+            )
+            snap["handoff_imports"] = self._stats["handoff_imports"]
+            snap["handoff_import_blocks"] = (
+                self._stats["handoff_import_blocks"]
+            )
         snap.update(self._prefix_snapshot())
         if self._continuous:
             snap["free_slots"] = free_slots
@@ -3259,6 +3628,7 @@ class ServingEngine:
             # stable schema next to brownout_shed above.
             snap["class_completed"] = dict(self._class_completed)
             snap["class_shed"] = dict(self._class_shed)
+        snap["role"] = self._role
         with self._cond:
             snap["class_backlog"] = self._class_backlog_locked()
         snap["mean_batch_occupancy"] = (

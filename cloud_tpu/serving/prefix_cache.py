@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 #: Scatter sentinel for "do not write this block": out of any real pool's
@@ -134,7 +135,9 @@ class PrefixCacheManager:
 
     def __init__(self, num_blocks: int, block_tokens: int, *,
                  dram_blocks: int = 0,
-                 demote_fn: Optional[Callable[[int], object]] = None):
+                 demote_fn: Optional[Callable[[int], object]] = None,
+                 summary_ttl_s: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None):
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         if block_tokens < 1:
@@ -145,10 +148,24 @@ class PrefixCacheManager:
             raise ValueError(
                 f"dram_blocks must be >= 0, got {dram_blocks}"
             )
+        if summary_ttl_s is not None and summary_ttl_s <= 0:
+            raise ValueError(
+                f"summary_ttl_s must be > 0, got {summary_ttl_s}"
+            )
         self.num_blocks = num_blocks
         self.block_tokens = block_tokens
         self.dram_blocks = dram_blocks
         self.demote_fn = demote_fn
+        #: TTL on :meth:`hot_prefixes` entries (None = never expire —
+        #: byte-identical to the pre-TTL summary).  ``clock`` is the
+        #: wall source (monotonic seconds); tests inject fakes.
+        self.summary_ttl_s = summary_ttl_s
+        self._wall = clock if clock is not None else time.monotonic
+        #: Last time each summary key was HIT (acquire / insert /
+        #: seed), keyed like ``_summary``.  Entries for keys no longer
+        #: in the summary are pruned at each rebuild, so it stays
+        #: bounded by the summary's own limit.
+        self._last_hit: Dict[int, float] = {}
         self._root = _Node(key=(), block=-1, parent=None)
         self._free: List[int] = list(range(num_blocks))[::-1]
         #: Eviction candidates — nodes that WERE (refs == 0, childless)
@@ -211,8 +228,49 @@ class PrefixCacheManager:
         key — ``_refresh_summary``).  Returns a SNAPSHOT: the summary
         is recomputed on the scheduler thread after every trie-shape
         change and swapped in whole, so ``health()`` callers on router
-        threads never walk a trie that is mutating under them."""
-        return dict(self._summary)
+        threads never walk a trie that is mutating under them.
+
+        With ``summary_ttl_s`` armed, entries whose prefix has not been
+        HIT (acquired, re-inserted, or handoff-seeded) within the TTL
+        are filtered out of the snapshot: a replica that lost its hot
+        tenant stops advertising stale cached-prefix credit to the
+        router cost model, even though the blocks may still sit in the
+        trie waiting for LRU pressure.  The blocks themselves remain
+        servable — a late request still hits; only the ADVERTISEMENT
+        ages out."""
+        summary = self._summary
+        ttl = self.summary_ttl_s
+        if ttl is None:
+            return dict(summary)
+        now = self._wall()
+        last = self._last_hit
+        return {
+            key: depth for key, depth in summary.items()
+            if now - last.get(key, now) <= ttl
+        }
+
+    def _touch_summary_key(self, lead_tokens: Sequence[int]) -> None:
+        """Refresh the TTL clock of the summary entry covering
+        ``lead_tokens`` (no-op without a TTL or below the affinity
+        span — such paths never appear in the summary at all)."""
+        if self.summary_ttl_s is None:
+            return
+        if len(lead_tokens) < AFFINITY_PREFIX_TOKENS:
+            return
+        key = hash(tuple(
+            int(t) for t in lead_tokens[:AFFINITY_PREFIX_TOKENS]
+        ))
+        self._last_hit[key] = self._wall()
+
+    def _lead_tokens(self, nodes: Sequence[_Node]) -> List[int]:
+        """The leading tokens of a root-down node chain, just enough to
+        cover the affinity span."""
+        lead: List[int] = []
+        for node in nodes:
+            lead.extend(node.key)
+            if len(lead) >= AFFINITY_PREFIX_TOKENS:
+                break
+        return lead
 
     def _maybe_refresh(self) -> None:
         """Rebuild the summary iff the trie's node set changed since
@@ -254,6 +312,14 @@ class PrefixCacheManager:
                     elif len(out) < limit:
                         out[k] = cdepth
                 stack.append((child, clead, cdepth))
+        if self.summary_ttl_s is not None:
+            # New keys start their TTL clock at first appearance; keys
+            # that left the summary drop their clock (bounds the map).
+            now = self._wall()
+            last = self._last_hit
+            self._last_hit = {
+                key: last.get(key, now) for key in out
+            }
         self._summary = out
 
     def _count(self, **deltas) -> None:
@@ -393,6 +459,7 @@ class PrefixCacheManager:
             )
             self._maybe_refresh()  # _allocate may have removed
         self._count(hits=1, hit_tokens=hit.tokens)
+        self._touch_summary_key(self._lead_tokens(hit.nodes))
         return plan
 
     def release(self, nodes: Sequence[_Node]) -> None:
@@ -462,7 +529,66 @@ class PrefixCacheManager:
         # (the steady hot state) must not pay the summary DFS on the
         # scheduler thread — and neither must pure demotions.
         self._maybe_refresh()
+        if offset >= AFFINITY_PREFIX_TOKENS:
+            self._touch_summary_key(tokens)
         return held, created, evicted
+
+    def seed_blocks(
+        self, keys: Sequence[Sequence[int]],
+    ) -> Tuple[List[_Node], List[_Node]]:
+        """Walk/extend the trie along exactly ``keys`` — one
+        ``block_tokens``-long token tuple per block, root-down — the
+        handoff-import seam: a decode replica plants the blocks a
+        prefill replica exported, so its very next lookup for the same
+        prompt is an ordinary prefix hit.
+
+        Allocates pool rows for missing nodes (evicting LRU leaves
+        under pressure, exactly like :meth:`insert`) and returns
+        ``(held, created)``: one reference taken on EVERY walked node —
+        the caller releases them once its own acquire has pinned the
+        hit, so allocation pressure in between can never evict the
+        seeded chain — and ``created`` the subset whose pool rows must
+        be WRITTEN (``upload_prefix_block``) by the caller before any
+        copy/attach reads them.  Blocks already cached are never
+        rewritten (same tokens, same bytes — the cross-replica dedup
+        that makes a re-handoff of a hot prefix nearly free).  Stops
+        early — seeding less — when allocation fails or the walk lands
+        on a DRAM-demoted node, mirroring :meth:`insert`'s contract:
+        the import is an accelerator, never a correctness dependency.
+        """
+        node = self._root
+        now = self._tick()
+        held: List[_Node] = []
+        created: List[_Node] = []
+        for key in keys:
+            key = tuple(int(t) for t in key)
+            if len(key) != self.block_tokens:
+                raise ValueError(
+                    f"seed key length {len(key)} != block_tokens "
+                    f"{self.block_tokens}"
+                )
+            child = node.children.get(key)
+            if child is not None and child.tier == "dram":
+                break
+            if child is None:
+                block, _ = self._allocate()
+                if block is None:
+                    break
+                child = _Node(key=key, block=block, parent=node)
+                node.children[key] = child
+                self._unmark_evictable(node)  # no longer a leaf
+                created.append(child)
+                self._shape_version += 1
+                self._count(saved_blocks=1)
+            child.refs += 1
+            child.last_used = now
+            self._unmark_evictable(child)
+            held.append(child)
+            node = child
+        self._maybe_refresh()
+        if len(held) * self.block_tokens >= AFFINITY_PREFIX_TOKENS:
+            self._touch_summary_key(self._lead_tokens(held))
+        return held, created
 
     def _allocate(self) -> Tuple[Optional[int], bool]:
         """A free pool row, or an evicted one: ``(block | None,

@@ -51,6 +51,19 @@ parity harness and ``check_chaos.py``'s degradation harness:
    requests included, with >=1 failed-over trace per arm), the report
    CLI rendering the TTFT decomposition table, token-for-token parity
    for EVERY request in both arms, and zero leaked threads.
+5. **disaggregated serving** (ISSUE 19) — a flash crowd of UNIQUE long
+   prompts through two 3-replica arms under the SAME two-fault chaos
+   plan (a mid-flood prefill-chunk hang that kills the prefill-owning
+   replica, then a decode hang that kills a decode-serving replica): a
+   colocated arm (roles unset) vs a 1-prefill/2-decode arm.  Asserted:
+   disagg decode TPOT p99 STRICTLY below colocated (prefill compute no
+   longer interleaves with decode steps), token parity for every
+   completed request in both arms, every measured request handed off,
+   >=1 decode-leg death re-prefilling through ``handoff_failovers``
+   and completing correctly, the re-handoff deduplicating through the
+   host pool, a positive ``handoff`` share in the disagg arm's traced
+   TTFT decomposition — and the colocated arm pinned byte-identical
+   (zero handoffs, zero host-pool traffic, zero handoff share).
 
 Prints one JSON line per phase plus a summary::
 
@@ -63,6 +76,7 @@ pattern as check_serving.py / check_chaos.py), so CI runs it every time.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -71,6 +85,19 @@ import time
 
 # CPU by default: a correctness harness, not a perf one.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# Multiple host devices (same idiom as tests/conftest.py, harmless when
+# the flag is already set): the disaggregated-serving phase pins each
+# replica's engine to its own virtual device so the arms model a fleet
+# of per-replica accelerators — without this every engine shares ONE
+# serial CPU execution queue and the prefill replica's async chunk
+# bursts serialize ahead of other replicas' decode steps, interference
+# no deployment topology could ever remove.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -874,6 +901,313 @@ def check_flash_crowd(timeout: float) -> dict:
     }
 
 
+def _decode_tpots(results):
+    """Per-request decode time-per-output-token, sorted: the decode-side
+    latency a disaggregated pool is supposed to protect.  ``latency -
+    ttft`` is the FINAL run's pure decode window (the fleet re-bases
+    both on failover, so a re-run never inflates its own TPOT — the
+    gate measures steady-state decode interference, not kill luck)."""
+    return sorted(
+        (r.latency_seconds - r.ttft_seconds)
+        / max(r.num_generated - 1, 1)
+        for r in results
+    )
+
+
+def _run_disagg_arm(params, config, *, roles, timeout: float) -> dict:
+    """One arm of the disaggregated-vs-colocated comparison: the SAME
+    long-prompt flash crowd (mostly UNIQUE prompts — a fully shared
+    prefix would let the colocated arm cache it and erase the
+    interference the split removes; a 6-request shared head rides along
+    to exercise the pool-dedup path) and the SAME two-fault chaos plan
+    through a 3-replica fleet, either colocated (``roles=None``) or
+    1-prefill/2-decode.
+
+    The chaos: a mid-flood prefill-chunk hang kills whichever replica
+    owns prefill (in the disagg arm, deterministically the prefill
+    replica — decode replicas haven't dispatched yet), and a later
+    decode hang kills a decode-serving replica, whose in-flight decode
+    legs must reset their handoff and RE-PREFILL elsewhere (the
+    ``handoff_failovers`` path).  Both arms run traced and dump the
+    merged timeline, so the disagg arm can gate the ``handoff`` share
+    in ``ttft_decomposition()`` and the colocated arm can pin it at
+    zero."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from cloud_tpu.fleet import Fleet, FleetConfig, default_route_policy
+    from cloud_tpu.monitoring import tracing
+    from cloud_tpu.monitoring.report import TraceReport
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils import faults
+
+    rng = np.random.default_rng(19)
+    n_requests = 18
+    budget = 32
+    # 4064 tokens = 507 full 8-token blocks handed off (the trie caps
+    # at len-1) + a 7-token tail the decode replica prefills itself.
+    # The length is the point: prefill FLOPs grow quadratically with
+    # the prompt while decode grows linearly, so at 4k each prefill is
+    # several times one request's whole decode window — the regime
+    # prefill/decode disaggregation exists for.  A colocated replica
+    # interleaves every admission's ~16 chunk dispatches of that work
+    # into its live decode windows; a decode replica admits the same
+    # request with one batched block upload.  The first 6 prompts
+    # share a 512-token head (the pool-dedup path); the rest are fully
+    # unique, so the colocated arm cannot cache its way out of the
+    # prefill load.
+    head = rng.integers(1, 255, 512).astype(np.int32)
+    prompts = [
+        np.concatenate([head, rng.integers(1, 255, 3552)]).astype(
+            np.int32
+        ) if i < 6 else rng.integers(1, 255, 4064).astype(np.int32)
+        for i in range(n_requests)
+    ]
+    serve = ServeConfig(
+        max_new_tokens=budget, prompt_buckets=(4096,),
+        batch_buckets=(1, 2), num_slots=2, chunk_tokens=2,
+        # Two pinned 507-block imports (one per slot) plus an incoming
+        # admission's worth of headroom.
+        prefix_cache_blocks=1536, prefix_block_tokens=8,
+        prefill_chunk_tokens=256,
+        # Loose enough that only the injected hangs trip it: real
+        # chunk dispatches on a loaded 3-engine CPU rig can run
+        # hundreds of ms (first-shape compiles, seconds).  The TPOT
+        # gate is unaffected — it reads each request's FINAL clean
+        # decode window.
+        dispatch_timeout_s=3.0, warmup=True,
+    )
+
+    # Role-tuned engines (the replica passes its role to factories
+    # that declare a ``role`` parameter): a decode replica never runs
+    # a prefill leg, so the device memory a colocated replica holds
+    # for prefill working state goes into a deeper prefix pool instead
+    # — imported prefixes outlive their slot pins, and the 6-request
+    # shared head keeps hitting on device rather than re-uploading
+    # from the host pool.  In the colocated arm every replica is
+    # ``"both"`` and gets the base config, byte-identical to a fleet
+    # built from a zero-arg factory.
+    decode_serve = dataclasses.replace(serve, prefix_cache_blocks=2048)
+
+    # One virtual host device per engine (round-robin over the forced
+    # multi-device CPU platform): committing each replica's params —
+    # and therefore every program and cache derived from them — to its
+    # own device gives each replica its own execution queue, the way a
+    # real fleet gives each replica its own accelerator.  Restarted
+    # engines take the next device, so a rebuild never queues behind a
+    # survivor.  Both arms pin identically; only the roles differ.
+    import itertools
+
+    import jax
+
+    devices = jax.devices()
+    next_device = itertools.count()
+
+    def factory(role="both"):
+        cfg = decode_serve if role == "decode" else serve
+        dev = devices[next(next_device) % len(devices)]
+        return ServingEngine(jax.device_put(params, dev), config, cfg,
+                             mesh=None)
+
+    tmpdir = tempfile.mkdtemp(prefix="cloud_tpu_check_disagg_")
+    timeline_path = os.path.join(tmpdir, "timeline.json")
+    try:
+        with tracing.collecting():
+            fleet = Fleet(factory, FleetConfig(
+                min_replicas=3, poll_interval_s=0.05, roles=roles,
+                host_pool_blocks=12288,
+                # Generous failover budget: while the (only) prefill
+                # replica rebuilds, every queued request retries
+                # through NoReplicaAvailableError until it returns.
+                route_policy=default_route_policy(max_attempts=40),
+            ))
+            fleet.wait_ready(timeout=timeout)
+            results = []
+            # Warm pass outside the fault plan, FULL SIZE and
+            # CONCURRENT — six unique full-length prompts spread by the
+            # least-loaded router across all three replicas, so EVERY
+            # engine compiles every shape the flood will dispatch (both
+            # chunk widths, batch-1 AND batch-2 decode, and in the
+            # disagg arm the whole export/stash/import handoff) before
+            # the kills arm.  A single warm request would leave the
+            # batch-2 decode executable cold fleet-wide and two of the
+            # three engines cold entirely — multi-second compiles
+            # landing inside measured decode windows.
+            n_warm = 6
+            warm_prompts = [
+                rng.integers(1, 255, 4064).astype(np.int32)
+                for _ in range(n_warm)
+            ]
+            warm_futures = [
+                fleet.submit(w, max_new_tokens=8) for w in warm_prompts
+            ]
+            for w, future in zip(warm_prompts, warm_futures):
+                results.append((w, 8, future.result(timeout=timeout)))
+            # The chaos plan: the 6th prefill-chunk dispatch after
+            # arming hangs past the watchdog — request 1's chunks are
+            # dispatched first, so in the disagg arm this lands on THE
+            # prefill replica mid-flood; later, the 60th continuous-
+            # decode dispatch hangs, killing a decode-serving replica
+            # with handoff-carrying requests in flight.
+            plan = [
+                {"site": "serve.prefill", "mode": "hang",
+                 "hang_s": 8.0, "nth": 6},
+                {"site": "serve.chunk", "mode": "hang",
+                 "hang_s": 8.0, "nth": 60},
+            ]
+            with faults.inject(plan) as active:
+                futures = [
+                    fleet.submit(p, max_new_tokens=budget)
+                    for p in prompts
+                ]
+                for prompt, future in zip(prompts, futures):
+                    results.append(
+                        (prompt, budget, future.result(timeout=timeout))
+                    )
+            # Let supervision converge before reading final state: both
+            # kill-closes must join their injected hangs and rebuild.
+            deadline = time.perf_counter() + timeout
+            while time.perf_counter() < deadline:
+                stats = fleet.stats()
+                health = fleet.health()
+                if (stats["restarts"] >= 2
+                        and health["ready_replicas"] == 3):
+                    break
+                time.sleep(0.05)
+            health = fleet.health()
+            stats = fleet.stats()
+            fleet.dump_timeline(timeline_path)
+            fleet.close()
+        leaked = _fleet_threads()
+        mismatches = _parity_mismatches(
+            params, config,
+            [r[0] for r in results], [r[1] for r in results],
+            [r[2] for r in results],
+        )
+        report = TraceReport.from_file(timeline_path)
+        decomposition = report.ttft_decomposition() or {}
+        handoff_share_p99 = (
+            decomposition.get("shares", {})
+            .get("handoff", {}).get("p99")
+        )
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    crowd = [r[2] for r in results[n_warm:]]  # the measured flood only
+    return {
+        "roles": list(roles) if roles else None,
+        "decode_tpots": _decode_tpots(crowd),
+        "completed": stats["completed"],
+        "expected": n_requests + n_warm,
+        "mismatches": mismatches,
+        "handoffs": stats["handoffs"],
+        "handoff_failovers": stats["handoff_failovers"],
+        "host_pool": stats["host_pool"],
+        "handoff_share_p99": handoff_share_p99,
+        "failovers": stats["failovers"],
+        "restarts": stats["restarts"],
+        "ready_replicas": health["ready_replicas"],
+        "replica_roles": {
+            str(snap["replica"]): snap["role"]
+            for snap in health["replicas"]
+        },
+        "faults_fired": active.fired(),
+        "leaked_threads": leaked,
+    }
+
+
+def check_disagg(timeout: float) -> dict:
+    """Phase 5 (ISSUE 19): a 1-prefill/2-decode fleet must hold decode
+    TPOT p99 STRICTLY below a colocated 3-replica fleet under the same
+    long-prompt flash crowd and the same mid-flood prefill-replica kill
+    + decode-replica kill — with token parity for every completed
+    request in both arms, >=1 handoff-failover request completing
+    correctly, and the colocated arm pinned byte-identical (zero
+    handoffs, zero handoff share in the TTFT decomposition)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import transformer
+
+    # A 4064-token chunked prefill is ~16 dispatches of quadratic
+    # attention work — several times one request's whole decode window,
+    # the interference the prefill/decode split exists to remove.
+    config = transformer.TINY.scaled(dtype=jnp.float32, num_layers=2)
+    params = transformer.init(jax.random.PRNGKey(3), config)
+    colocated = _run_disagg_arm(params, config, roles=None,
+                                timeout=timeout)
+    disagg = _run_disagg_arm(
+        params, config, roles=("prefill", "decode", "decode"),
+        timeout=timeout,
+    )
+    colocated_p99 = _p99(colocated["decode_tpots"])
+    disagg_p99 = _p99(disagg["decode_tpots"])
+    ok = (
+        # The headline gate: decode-side TPOT p99 strictly better.
+        disagg_p99 < colocated_p99
+        # Parity + completeness in BOTH arms (failed-over included).
+        and colocated["mismatches"] == 0
+        and disagg["mismatches"] == 0
+        and colocated["completed"] == colocated["expected"]
+        and disagg["completed"] == disagg["expected"]
+        # The chaos actually happened, in both arms, and both replicas
+        # were rebuilt.
+        and colocated["faults_fired"] == {
+            "serve.prefill": 1, "serve.chunk": 1,
+        }
+        and disagg["faults_fired"] == {
+            "serve.prefill": 1, "serve.chunk": 1,
+        }
+        and colocated["restarts"] >= 2
+        and disagg["restarts"] >= 2
+        and colocated["ready_replicas"] == 3
+        and disagg["ready_replicas"] == 3
+        # Disagg semantics: every measured request handed off, >=1
+        # decode-leg death re-prefilled (handoff_failovers) and still
+        # completed correctly (parity above covers the whole set), and
+        # the re-handoff deduplicated through the host pool.
+        and disagg["handoffs"] >= disagg["expected"]
+        and disagg["handoff_failovers"] >= 1
+        and disagg["host_pool"]["dedup_hits"] >= 1
+        and (disagg["handoff_share_p99"] or 0) > 0
+        and disagg["replica_roles"] == {
+            "0": "prefill", "1": "decode", "2": "decode",
+        }
+        # Colocated arm pinned byte-identical: no handoff ever built.
+        and colocated["handoffs"] == 0
+        and colocated["handoff_failovers"] == 0
+        and colocated["host_pool"] == {
+            "puts": 0, "dedup_hits": 0, "gets": 0, "misses": 0,
+            "evictions": 0, "blocks": 0,
+        }
+        and not colocated["handoff_share_p99"]
+        and not colocated["leaked_threads"]
+        and not disagg["leaked_threads"]
+    )
+    return {
+        "phase": "disagg",
+        "ok": ok,
+        "colocated_decode_tpot_p99": round(colocated_p99, 5),
+        "disagg_decode_tpot_p99": round(disagg_p99, 5),
+        "mismatches": colocated["mismatches"] + disagg["mismatches"],
+        "handoffs": {"colocated": colocated["handoffs"],
+                     "disagg": disagg["handoffs"]},
+        "handoff_failovers": disagg["handoff_failovers"],
+        "host_pool_dedup_hits": disagg["host_pool"]["dedup_hits"],
+        "handoff_share_p99": disagg["handoff_share_p99"],
+        "failovers": {"colocated": colocated["failovers"],
+                      "disagg": disagg["failovers"]},
+        "restarts": {"colocated": colocated["restarts"],
+                     "disagg": disagg["restarts"]},
+        "faults_fired": {"colocated": colocated["faults_fired"],
+                         "disagg": disagg["faults_fired"]},
+        "leaked_threads": (
+            colocated["leaked_threads"] + disagg["leaked_threads"]
+        ),
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--timeout", type=float, default=240.0,
@@ -886,6 +1220,7 @@ def main(argv=None) -> int:
         check_autoscale(args.timeout),
         check_mixed_tenant_qos(args.timeout),
         check_flash_crowd(args.timeout),
+        check_disagg(args.timeout),
     ]
     for phase in phases:
         print(json.dumps(phase), flush=True)
@@ -909,9 +1244,16 @@ def main(argv=None) -> int:
         ),
         "flash_crowd_hit_tokens": phases[3]["hit_tokens"],
         "flash_crowd_trace_complete": phases[3]["trace_complete"],
+        "disagg_tpot_win": (
+            phases[4]["disagg_decode_tpot_p99"]
+            < phases[4]["colocated_decode_tpot_p99"]
+        ),
+        "disagg_handoffs": phases[4]["handoffs"]["disagg"],
+        "disagg_handoff_failovers": phases[4]["handoff_failovers"],
         "leaked_threads": (
             phases[0]["leaked_threads"] + phases[1]["leaked_threads"]
             + phases[2]["leaked_threads"] + phases[3]["leaked_threads"]
+            + phases[4]["leaked_threads"]
         ),
         "wall_seconds": round(time.perf_counter() - start, 3),
     }), flush=True)

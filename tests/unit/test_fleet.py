@@ -1030,7 +1030,7 @@ def test_check_fleet_script():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "scripts",
                                       "check_fleet.py")],
-        capture_output=True, text=True, timeout=500,
+        capture_output=True, text=True, timeout=900,
         cwd=REPO_ROOT, env=dict(os.environ, JAX_PLATFORMS="cpu"),
     )
     assert proc.returncode == 0, (proc.stdout or "") + (proc.stderr or "")

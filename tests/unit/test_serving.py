@@ -418,6 +418,14 @@ class TestHealth:
         # ISSUE 17: the decode-kernel selection is schema in BOTH
         # schedulers — the default is (and must stay) the XLA path.
         assert health["decode_kernel"] == "xla"
+        # ISSUE 19: the disaggregated-serving keys are schema in BOTH
+        # schedulers — role "both" and zero handoff counters whenever
+        # no role is assigned and no handoff submits arrive (pinned
+        # byte-identical to the colocated engine).
+        assert health["role"] == "both"
+        for key in ("handoff_exports", "handoff_export_blocks",
+                    "handoff_imports", "handoff_import_blocks"):
+            assert health[key] == 0, key
 
     def _assert_qos_stats_zero(self, stats):
         """ISSUE 14: the QoS stats keys are schema in both schedulers —
@@ -433,6 +441,12 @@ class TestHealth:
         # ISSUE 17: block-table prefix attaches are schema too — zero
         # whenever decode_kernel="xla" (hits copy, never attach).
         assert stats["prefix_attaches"] == 0
+        # ISSUE 19: the disagg stats keys mirror health — "both"/zeros
+        # on every engine that never serves a handoff leg.
+        assert stats["role"] == "both"
+        for key in ("handoff_exports", "handoff_export_blocks",
+                    "handoff_imports", "handoff_import_blocks"):
+            assert stats[key] == 0, key
 
     def test_continuous_health_carries_load_signal(self, model):
         config, params = model

@@ -429,6 +429,109 @@ class TestRouterCostModel:
             LeastLoadedRouter(cache_alpha=-0.5)
 
 
+class TestSummaryTTL:
+    """ISSUE 19 satellite: ``summary_ttl_s`` ages stale entries out of
+    the router-facing ``hot_prefixes()`` summary — a replica that lost
+    its hot tenant stops advertising cached-prefix credit, while the
+    blocks themselves stay servable until LRU pressure takes them."""
+
+    @staticmethod
+    def _manager(ttl, now):
+        return PrefixCacheManager(
+            num_blocks=16, block_tokens=4, summary_ttl_s=ttl,
+            clock=lambda: now[0],
+        )
+
+    def test_stale_entry_expires_but_blocks_still_serve(self):
+        from cloud_tpu.serving.prefix_cache import AFFINITY_PREFIX_TOKENS
+
+        now = [0.0]
+        m = self._manager(10.0, now)
+        head = list(range(100, 100 + AFFINITY_PREFIX_TOKENS + 8))
+        held, _, _ = m.insert(head + [1], PrefixHit(nodes=(), tokens=0))
+        m.release(held)
+        (key,) = m.hot_prefixes()
+        # Within the TTL the advertisement holds…
+        now[0] = 9.0
+        assert key in m.hot_prefixes()
+        # …past it the ADVERTISEMENT drops, the blocks do not: a late
+        # request still hits the trie at full depth.
+        now[0] = 11.0
+        assert m.hot_prefixes() == {}
+        hit = m.match(head + [5])
+        assert hit.tokens == len(head)
+        # The hit refreshes the clock — the entry comes back hot.
+        assert m.acquire(hit)
+        m.release(list(hit.nodes))
+        assert key in m.hot_prefixes()
+        now[0] = 22.0
+        assert m.hot_prefixes() == {}
+
+    def test_clock_map_prunes_with_the_summary(self):
+        from cloud_tpu.serving.prefix_cache import AFFINITY_PREFIX_TOKENS
+
+        now = [0.0]
+        m = self._manager(10.0, now)
+        head = list(range(100, 100 + AFFINITY_PREFIX_TOKENS))
+        held, _, _ = m.insert(head + [1], PrefixHit(nodes=(), tokens=0))
+        m.release(held)
+        assert len(m._last_hit) == 1
+        # Evicting the prefix drops its summary entry AND its TTL
+        # clock — the map is bounded by the summary, not by traffic.
+        m.evict_prefix(head + [1])
+        assert m.hot_prefixes() == {}
+        assert m._last_hit == {}
+
+    def test_ttl_off_is_byte_identical(self):
+        from cloud_tpu.serving.prefix_cache import AFFINITY_PREFIX_TOKENS
+
+        m = PrefixCacheManager(num_blocks=16, block_tokens=4)
+        assert m.summary_ttl_s is None
+        head = list(range(100, 100 + AFFINITY_PREFIX_TOKENS))
+        held, _, _ = m.insert(head + [1], PrefixHit(nodes=(), tokens=0))
+        m.release(held)
+        assert len(m.hot_prefixes()) == 1
+        assert m._last_hit == {}  # no clock bookkeeping at all
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="summary_ttl_s"):
+            PrefixCacheManager(num_blocks=4, block_tokens=4,
+                               summary_ttl_s=0.0)
+
+    def test_router_stops_crediting_expired_summary(self):
+        """The router-level pin: the cost model reads the LIVE (TTL-
+        filtered) summary through ``health()``, so an aged-out prefix
+        stops pulling traffic to the busier replica."""
+        from cloud_tpu.fleet.router import LeastLoadedRouter
+        from cloud_tpu.serving.prefix_cache import (
+            AFFINITY_PREFIX_TOKENS,
+            affinity_key,
+        )
+
+        now = [0.0]
+        m = self._manager(10.0, now)
+        head = list(range(100, 100 + AFFINITY_PREFIX_TOKENS + 16))
+        held, _, _ = m.insert(head + [1], PrefixHit(nodes=(), tokens=0))
+        m.release(held)
+        key = affinity_key(head)
+
+        cold = _FakeReplica(1, 0)
+
+        class _LiveHealthReplica(_FakeReplica):
+            def health(self):
+                snap = dict(self._health)
+                snap["cached_prefixes"] = m.hot_prefixes()
+                return snap
+
+        warm = _LiveHealthReplica(0, 2)
+        router = LeastLoadedRouter(cache_alpha=0.1)
+        picked, _ = router.pick([warm, cold], affinity_key=key)
+        assert picked.id == 0  # 2 - 0.1*tokens beats idle 0
+        now[0] = 11.0  # the tenant went quiet; the credit ages out
+        picked, _ = router.pick([warm, cold], affinity_key=key)
+        assert picked.id == 1
+
+
 class TestReportPrefixSection:
     def _event(self, name, ts, dur, **args):
         return {"name": name, "ph": "X", "ts": ts, "dur": dur,
@@ -513,54 +616,98 @@ class TestReportPrefixSection:
 
 
 class TestDemoteBurst:
-    """ISSUE 16 satellite: a demotion burst shares ONE supervised
-    worker thread instead of paying a fresh watchdog dispatch thread
-    per evicted block, with ``_supervised``'s full timeout contract
-    applied per call."""
+    """ISSUE 19 satellite: a demotion burst DEFERS every download into
+    one batch and flushes the whole batch under ONE supervised dispatch
+    at scope exit — one watchdog thread per burst, pinned — instead of
+    paying a fresh dispatch thread per evicted block."""
 
     class _StubEngine:
-        """The slice of ServingEngine the burst dispatcher touches."""
+        """The slice of ServingEngine the demote paths touch."""
 
         def __init__(self, timeout):
             import threading
 
+            import numpy as np
+
             from cloud_tpu.serving import ServeConfig
+            from cloud_tpu.serving.engine import ServingEngine
 
             self.serve_config = ServeConfig(dispatch_timeout_s=timeout)
-            self._demote_dispatcher = None
+            # The REAL watchdog and flush, bound to this stub — the
+            # burst paths must compose with the genuine supervision
+            # contract.
+            self._supervised = ServingEngine._supervised.__get__(self)
+            self._flush_demotes = (
+                ServingEngine._flush_demotes.__get__(self)
+            )
+            self._demote_batch = None
+            self._prefix_pool = object()  # opaque to the fake cell
             self._last_dispatch_ts = None
             self._orphan_dispatches = []
             self._unhealthy_reason = None
             self._stats = {"watchdog_timeouts": 0}
             self._stats_lock = threading.Lock()
+            self.download_threads = []
 
-    def test_burst_runs_every_call_on_one_worker_thread(self):
+            def fake_cell(pool, block):
+                self.download_threads.append(
+                    threading.current_thread()
+                )
+                return np.asarray(int(block) * 10)
+
+            self._download_cell = lambda: fake_cell
+
+    def test_burst_defers_then_flushes_as_one_dispatch(self):
         import threading
 
-        from cloud_tpu.serving.engine import ServingEngine
+        from cloud_tpu.serving.engine import (
+            ServingEngine,
+            _DeferredPayload,
+            _resolve_payload,
+        )
 
         engine = self._StubEngine(timeout=5.0)
-        workers = []
+        placeholders = []
         with ServingEngine._demote_burst(engine):
-            burst = engine._demote_dispatcher
-            assert burst is not None
-            for i in range(5):
-                value = burst.call(
-                    "serve/prefix_demote",
-                    lambda i=i: (workers.append(
-                        threading.current_thread()
-                    ), i)[1],
-                )
-                assert value == i
-        # The thread-count pin: five demotions, ONE dispatch thread —
-        # and never the caller's own.
-        assert len({t.ident for t in workers}) == 1
-        assert workers[0] is not threading.current_thread()
-        assert not workers[0].is_alive()  # shutdown joined it
-        assert engine._demote_dispatcher is None  # scope cleared
+            for block in range(5):
+                payload = ServingEngine._demote_block(engine, block)
+                assert isinstance(payload, _DeferredPayload)
+                assert not payload.filled
+                placeholders.append(payload)
+            # Nothing downloads mid-burst — the trie holds placeholders.
+            assert engine.download_threads == []
+            assert len(engine._demote_batch) == 5
+        # Burst exit flushed every download, filled in order…
+        assert engine._demote_batch is None
+        for block, payload in enumerate(placeholders):
+            assert payload.filled
+            assert int(_resolve_payload(payload)) == block * 10
+        # …on ONE supervised worker thread (the thread-count pin:
+        # five demotions, one dispatch thread, never the caller's own).
+        assert len(engine.download_threads) == 5
+        assert len({t.ident for t in engine.download_threads}) == 1
+        assert engine.download_threads[0] is not (
+            threading.current_thread()
+        )
         assert engine._orphan_dispatches == []
 
-    def test_burst_timeout_latches_unhealthy_and_skips_the_rest(self):
+    def test_unfilled_placeholder_read_is_typed(self):
+        import numpy as np
+
+        from cloud_tpu.serving.engine import (
+            _DeferredPayload,
+            _resolve_payload,
+        )
+
+        # A placeholder consumed before its burst flushed is a bug in
+        # the dispatch ordering — fail loudly, never upload garbage.
+        with pytest.raises(RuntimeError, match="burst"):
+            _resolve_payload(_DeferredPayload())
+        # Plain (already-downloaded) payloads pass through untouched.
+        payload = np.arange(3)
+        assert _resolve_payload(payload) is payload
+
+    def test_burst_flush_timeout_latches_unhealthy(self):
         import threading
 
         from cloud_tpu.serving.engine import (
@@ -570,32 +717,71 @@ class TestDemoteBurst:
 
         engine = self._StubEngine(timeout=0.05)
         release = threading.Event()
-        with ServingEngine._demote_burst(engine):
-            burst = engine._demote_dispatcher
-            with pytest.raises(DispatchTimeoutError, match="exceeded"):
-                burst.call("serve/prefix_demote", release.wait)
-            # The wedged worker is orphan-tracked, the engine latched
-            # unhealthy, and queueing behind the hang is refused.
-            assert engine._unhealthy_reason is not None
-            assert engine._stats["watchdog_timeouts"] == 1
-            assert len(engine._orphan_dispatches) == 1
-            with pytest.raises(DispatchTimeoutError, match="skipped"):
-                burst.call("serve/prefix_demote", lambda: 1)
+
+        def wedged_cell(pool, block):
+            release.wait()
+
+        engine._download_cell = lambda: wedged_cell
+        with pytest.raises(DispatchTimeoutError, match="exceeded"):
+            with ServingEngine._demote_burst(engine):
+                ServingEngine._demote_block(engine, 0)
+        # The wedged worker is orphan-tracked and the engine latched
+        # unhealthy — same contract as every supervised dispatch.
+        assert engine._unhealthy_reason is not None
+        assert engine._stats["watchdog_timeouts"] == 1
+        assert len(engine._orphan_dispatches) == 1
         release.set()  # unwedge the daemon worker
 
-    def test_burst_is_a_noop_without_watchdog_or_when_nested(self):
-        from cloud_tpu.serving.engine import ServingEngine
+    def test_burst_batches_inline_without_watchdog(self):
+        import threading
 
-        # dispatch_timeout_s=None runs demotions inline anyway.
+        from cloud_tpu.serving.engine import (
+            ServingEngine,
+            _resolve_payload,
+        )
+
+        # dispatch_timeout_s=None still batches (one download window),
+        # the flush just runs inline on the caller's thread.
         engine = self._StubEngine(timeout=None)
         with ServingEngine._demote_burst(engine):
-            assert engine._demote_dispatcher is None
-        # Nested bursts keep the OUTER dispatcher (still one thread).
+            payload = ServingEngine._demote_block(engine, 3)
+        assert int(_resolve_payload(payload)) == 30
+        assert engine.download_threads == [threading.current_thread()]
+
+    def test_nested_bursts_share_the_outer_batch(self):
+        from cloud_tpu.serving.engine import ServingEngine
+
         engine = self._StubEngine(timeout=5.0)
         with ServingEngine._demote_burst(engine):
-            outer = engine._demote_dispatcher
+            outer = engine._demote_batch
+            ServingEngine._demote_block(engine, 0)
             with ServingEngine._demote_burst(engine):
-                assert engine._demote_dispatcher is outer
+                assert engine._demote_batch is outer
+                ServingEngine._demote_block(engine, 1)
+            # Inner exit must NOT flush — the outer scope owns it.
+            assert engine.download_threads == []
+            assert len(engine._demote_batch) == 2
+        assert len(engine.download_threads) == 2
+        assert len({t.ident for t in engine.download_threads}) == 1
+
+    def test_non_burst_demote_keeps_per_block_dispatch(self):
+        import threading
+
+        from cloud_tpu.serving.engine import (
+            ServingEngine,
+            _DeferredPayload,
+        )
+
+        engine = self._StubEngine(timeout=5.0)
+        payload = ServingEngine._demote_block(engine, 7)
+        # Outside a burst the download is immediate — a real payload,
+        # not a placeholder — still under its own watchdog thread.
+        assert not isinstance(payload, _DeferredPayload)
+        assert int(payload) == 70
+        assert len(engine.download_threads) == 1
+        assert engine.download_threads[0] is not (
+            threading.current_thread()
+        )
 
 
 class TestServeConfigKnobs:
@@ -618,11 +804,27 @@ class TestServeConfigKnobs:
             ServeConfig(prefix_cache_blocks=4, prefix_dram_blocks=-1)
         with pytest.raises(ValueError, match="prefix_dram_blocks"):
             ServeConfig(prefix_dram_blocks=8)
+        # ISSUE 19: a disaggregated role needs the continuous scheduler
+        # AND a prefix pool (the KV handoff is prefix-block traffic),
+        # and the summary TTL must be a positive window or None.
+        with pytest.raises(ValueError, match="role"):
+            ServeConfig(role="router")
+        with pytest.raises(ValueError, match="prefix_cache_blocks"):
+            ServeConfig(role="prefill")
+        with pytest.raises(ValueError, match="continuous"):
+            ServeConfig(scheduler="batch", role="decode")
+        with pytest.raises(ValueError, match="prefix_summary_ttl_s"):
+            ServeConfig(prefix_summary_ttl_s=0.0)
+        assert ServeConfig(
+            role="decode", prefix_cache_blocks=4
+        ).role == "decode"
         # Compatibility default: every knob off.
         cfg = ServeConfig()
         assert cfg.prefix_cache_blocks == 0
         assert cfg.prefix_dram_blocks == 0
         assert cfg.prefill_chunk_tokens is None
+        assert cfg.role == "both"
+        assert cfg.prefix_summary_ttl_s is None
 
 
 # --------------------------------------------------------------------------

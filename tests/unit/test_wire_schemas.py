@@ -208,6 +208,74 @@ class TestTpuV2Pins:
         with pytest.raises(ValueError, match="num_replicas"):
             deploy.build_serve_fleet_request("img", TPU, 0, plan)
 
+    def test_serve_fleet_role_axis_defaults_to_both(self):
+        """roles=None (the colocated fleet) still carries the role axis
+        — every node "both", every label "both" — so fleet tooling
+        reads ONE schema whether or not disaggregation is armed."""
+        plan = planner.plan_mesh(chief_config=TPU)
+        request = deploy.build_serve_fleet_request(
+            "img", TPU, 2, plan, job_id="fleet",
+        )
+        topo = request["slice_topology"]
+        assert topo["roles"] == {"fleet-r0": "both", "fleet-r1": "both"}
+        for body in request["nodes"].values():
+            validate(TPU_SCHEMA, "Node", body)
+            assert body["labels"]["cloud_tpu_serve_role"] == "both"
+
+    def test_serve_fleet_mixed_roles_on_v5e(self):
+        """A disaggregated TPU_V5E fleet: one prefill replica, two
+        decode replicas — the role axis records the split per node id,
+        each node's label matches, and every body still validates
+        against the service schema (roles ride in labels/topology, not
+        new Node fields)."""
+        cfg = machine_config.COMMON_MACHINE_CONFIGS["TPU_V5E_32"]
+        plan = planner.plan_mesh(chief_config=cfg)
+        request = deploy.build_serve_fleet_request(
+            "img", cfg, 3, plan, job_id="split",
+            roles=("prefill", "decode", "decode"),
+        )
+        topo = request["slice_topology"]
+        assert topo["roles"] == {
+            "split-r0": "prefill",
+            "split-r1": "decode",
+            "split-r2": "decode",
+        }
+        expected = {"split-r0": "prefill", "split-r1": "decode",
+                    "split-r2": "decode"}
+        for node_id, body in request["nodes"].items():
+            validate(TPU_SCHEMA, "Node", body)
+            assert (
+                body["labels"]["cloud_tpu_serve_role"] == expected[node_id]
+            )
+        # Short role tuples pad with "both" (scale-up replicas serve
+        # either leg).
+        request = deploy.build_serve_fleet_request(
+            "img", cfg, 3, plan, job_id="pad", roles=("prefill", "decode"),
+        )
+        assert request["slice_topology"]["roles"]["pad-r2"] == "both"
+
+    def test_serve_fleet_rejects_unroutable_role_splits(self):
+        """A split with no decode-capable (or no prefill-capable)
+        replica could never complete a request — rejected at build
+        time, same contract as fleet.disagg.validate_roles."""
+        plan = planner.plan_mesh(chief_config=TPU)
+        with pytest.raises(ValueError, match="decode-capable"):
+            deploy.build_serve_fleet_request(
+                "img", TPU, 2, plan, roles=("prefill", "prefill"),
+            )
+        with pytest.raises(ValueError, match="prefill-capable"):
+            deploy.build_serve_fleet_request(
+                "img", TPU, 2, plan, roles=("decode", "decode"),
+            )
+        with pytest.raises(ValueError, match="role"):
+            deploy.build_serve_fleet_request(
+                "img", TPU, 2, plan, roles=("prefill", "mixed"),
+            )
+        with pytest.raises(ValueError, match="entries"):
+            deploy.build_serve_fleet_request(
+                "img", TPU, 1, plan, roles=("prefill", "decode"),
+            )
+
     def test_deploy_urls_match_vendored_methods(self):
         """Every call deploy_job + supervise_job + delete_job makes must
         resolve to a vendored TPU v2 method — including the supervisor's
