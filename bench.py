@@ -322,6 +322,14 @@ def _emit_phase(phase, **payload):
     print(json.dumps({"phase": phase, **payload}), flush=True)
 
 
+class HeadlineInvalid(RuntimeError):
+    """A phase produced a headline number that cannot be real (zero,
+    negative, NaN, inf).  Raised INSIDE the measuring child so the
+    parent records a typed failure instead of publishing the bogus
+    value — rounds r03-r05 shipped 0.0 steps/sec unflagged because the
+    only gate was 'the phase did not raise'."""
+
+
 # --------------------------------------------------------------------------
 # Probe child: the cheapest possible proof the tunnel is alive.
 
@@ -341,6 +349,18 @@ def _probe_main() -> int:
     for _ in range(2):  # chained — a hung tunnel cannot satisfy the read
         y = y @ x
     checksum = float(y.astype(jnp.float32).sum())
+    # A probe that "succeeds" with a garbage checksum is a hung/broken
+    # device lying about liveness: fail the probe with a typed error
+    # (nonzero exit) instead of green-lighting a measurement attempt.
+    expect = float(32 ** 4)  # ones@ones twice: 32*32 entries, each 32*32
+    if checksum != expect:
+        _emit_phase(
+            "probe", ok=False,
+            error=(
+                f"ProbeChecksumMismatch: got {checksum!r}, want {expect!r}"
+            ),
+        )
+        return 1
     # Cache-miss vs cache-hit timing of one jitted matmul: the bench-side
     # proxy for submit-to-first-step (cold_compile ~ what a fresh process
     # pays before its first dispatch; warm_dispatch ~ with a ready
@@ -411,6 +431,14 @@ def _measure_resnet(extras, *, corrected=False):
         extras, "", imagenet_shape=False,
         batch_size=BATCH_SIZE, warmup=WARMUP_STEPS, iters=MEASURE_STEPS,
     )
+    # Fail LOUDLY on a number that cannot be a measurement: a 0.0 (or
+    # NaN/inf) headline must surface as a typed phase error the parent
+    # records and retries on, never as the value of record.
+    if not (steps_per_sec > 0.0 and steps_per_sec < float("inf")):
+        raise HeadlineInvalid(
+            f"resnet measured {steps_per_sec!r} steps/sec — refusing to "
+            "publish a non-positive/non-finite headline"
+        )
     _emit_phase(
         "resnet", ok=True, value=steps_per_sec, corrected=corrected,
         extras=extras,
@@ -1362,6 +1390,103 @@ def _measure_serving_decode_kernel(extras):
         )
 
 
+def _measure_serving_pipeline(extras):
+    """Pipelined-scheduling probe: the churn workload through a
+    ``pipeline_depth=1`` engine (today's lockstep dispatch->sync loop)
+    and a ``pipeline_depth=2`` engine (second chunk in flight while the
+    host drains the first).  Emits ``serve_pipeline_tokens_per_sec``,
+    ``serve_pipeline_vs_depth1_speedup``, and per-arm dispatch-gap
+    p50/p99 (from ``engine.stats()`` — the host-side gap between
+    consecutive chunk dispatches, the latency the pipeline exists to
+    hide), parity-gated like ``serving_decode_kernel``: a token
+    mismatch between the arms zeroes the rates rather than publishing
+    a speedup for wrong tokens.
+    """
+    from cloud_tpu.serving import ServeConfig, ServingEngine
+    from cloud_tpu.utils.benchmarking import decode_setup
+
+    import numpy as np
+
+    cfg, params, _, _ = decode_setup(
+        batch_size=SERVE_MAX_BATCH, prompt_len=SERVE_PROMPT_BUCKET
+    )
+    rng = np.random.default_rng(11)
+    lengths = rng.integers(
+        8, SERVE_PROMPT_BUCKET + 1, SERVE_CHURN_REQUESTS
+    )
+    budgets = rng.integers(
+        SERVE_NEW_TOKENS // 4, SERVE_NEW_TOKENS + 1, SERVE_CHURN_REQUESTS
+    )
+    prompts = [
+        rng.integers(1, cfg.vocab_size, n).astype(np.int32) for n in lengths
+    ]
+
+    def churn(depth):
+        serve = ServeConfig(
+            max_new_tokens=SERVE_NEW_TOKENS,
+            prompt_buckets=(SERVE_PROMPT_BUCKET // 2, SERVE_PROMPT_BUCKET),
+            num_slots=SERVE_MAX_BATCH,
+            chunk_tokens=SERVE_CHURN_CHUNK,
+            warmup=True,
+            pipeline_depth=depth,
+        )
+        with ServingEngine(params, cfg, serve, mesh=None) as engine:
+            engine.wait_ready()
+            engine.submit(prompts[0]).result()  # absorb first dispatch
+            start = time.perf_counter()
+            futures = []
+            for i, prompt in enumerate(prompts):
+                futures.append(
+                    engine.submit(prompt, max_new_tokens=int(budgets[i]))
+                )
+                if (i + 1) % (SERVE_MAX_BATCH // 2) == 0:
+                    time.sleep(0.02)  # staggered waves, not one burst
+            results = [f.result() for f in futures]
+            wall = time.perf_counter() - start
+            stats = engine.stats()
+        return results, tokens_rate(results, wall), stats
+
+    def tokens_rate(results, wall):
+        tokens = sum(r.num_generated for r in results)
+        return tokens / wall if wall else 0.0
+
+    d1_results, d1_rate, d1_stats = churn(1)
+    d2_results, d2_rate, d2_stats = churn(2)
+
+    mismatches = sum(
+        1 for a, b in zip(d2_results, d1_results)
+        if not np.array_equal(a.tokens, b.tokens)
+        or a.num_generated != b.num_generated
+    )
+    ok = mismatches == 0
+
+    for arm, stats in (("depth1", d1_stats), ("depth2", d2_stats)):
+        extras[f"serve_pipeline_{arm}_gap_p50_ms"] = round(
+            stats.get("dispatch_gap_ms_p50", 0.0), 3
+        )
+        extras[f"serve_pipeline_{arm}_gap_p99_ms"] = round(
+            stats.get("dispatch_gap_ms_p99", 0.0), 3
+        )
+    extras["serve_pipeline_tokens_per_sec"] = round(
+        d2_rate if ok else 0.0, 1
+    )
+    extras["serve_pipeline_vs_depth1_speedup"] = round(
+        d2_rate / d1_rate if ok and d1_rate else 0.0, 3
+    )
+    extras["serve_pipeline_depth1_tokens_per_sec"] = round(d1_rate, 1)
+    extras["serve_pipeline_parity_mismatches"] = mismatches
+    extras["serve_pipeline_config"] = (
+        f"SMALL pipeline_depth=2 slots{SERVE_MAX_BATCH} "
+        f"chunk{SERVE_CHURN_CHUNK} new<= {SERVE_NEW_TOKENS} "
+        f"n{SERVE_CHURN_REQUESTS} staggered"
+    )
+    if not ok:
+        raise RuntimeError(
+            f"pipelined arm failed parity: {mismatches} mismatched "
+            "request(s) vs the depth-1 arm"
+        )
+
+
 def _measure_fleet(extras):
     """Fleet probe: the churn workload (staggered arrivals, mixed prompt
     AND output lengths) through ``cloud_tpu.fleet.Fleet`` fronting
@@ -1851,6 +1976,7 @@ def _child_main() -> int:
         (_measure_serving_spec, "serving_spec"),
         (_measure_serving_tp, "serving_tp"),
         (_measure_serving_decode_kernel, "serving_decode_kernel"),
+        (_measure_serving_pipeline, "serving_pipeline"),
         (_measure_fleet, "fleet"),
         (_measure_fleet_qps_sweep, "fleet_qps_sweep"),
         (_measure_fleet_disagg, "fleet_disagg"),
@@ -2280,6 +2406,12 @@ def _main_locked() -> int:
         _emit(float(daemon["value"]), extras=extras,
               error="; ".join([note] + errors))
         return 0
+    # No headline anywhere (driver attempts AND the daemon fallback all
+    # empty): the 0.0 below is a SENTINEL, not a measurement.  Stamp a
+    # typed marker so downstream consumers can distinguish "bench broke"
+    # from "the model got infinitely slow" without parsing error prose —
+    # r03-r05 shipped this exact 0.0 unflagged.
+    merged["error_type"] = "NoHeadlineMeasured"
     _emit(0.0, extras=merged, error="; ".join(errors) or "no attempts ran")
     return 1
 

@@ -215,7 +215,12 @@ class Replica:
                 "prefix_dram_hits": 0, "prefix_dram_hit_tokens": 0,
                 "prefix_dram_demotions": 0, "prefix_dram_evictions": 0,
                 "prefix_dram_swapin_failures": 0,
+                "prefix_deferred_saves": 0,
                 "cached_prefixes": {},
+                # Pipelined-scheduling schema (an engineless replica
+                # schedules nothing): depth 1, no dispatch gap — the
+                # supervisor's gap gauges read these without probing.
+                "pipeline_depth": 1, "dispatch_gap_ms": 0.0,
                 # Disaggregated-serving schema (an engineless replica
                 # still advertises its assigned role; handoff counters
                 # are zero — stable shape next to the prefix keys).

@@ -812,6 +812,7 @@ def decode_chunk_program(
     pool=None,
     block_table=None,
     use_pallas=None,
+    with_summary: bool = False,
 ):
     """Advance every active slot by up to ``chunk_size`` tokens.
 
@@ -839,6 +840,14 @@ def decode_chunk_program(
     routes every step's attention through the paged read-in-place path
     (see :func:`_decode_step`); the defaults keep the trace
     byte-identical to the pre-paged program.
+
+    ``with_summary=True`` appends a fifth result: a device int32
+    ``[emitted_count, active_count]`` pair reduced from the emission
+    mask inside the program, so a pipelined scheduler can learn a
+    chunk's occupancy from a two-element host copy without
+    materializing the full [num_slots, chunk_size] grids at dispatch
+    time.  ``False`` (default) keeps the trace byte-identical to
+    today's four-tuple.
     """
     if chunk_size < 1:
         raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
@@ -891,6 +900,12 @@ def decode_chunk_program(
     (cache, state), (toks, valid) = jax.lax.scan(
         step, (cache, state), jax.random.split(rng, chunk_size)
     )
+    if with_summary:
+        summary = jnp.stack([
+            valid.sum().astype(jnp.int32),
+            state["active"].sum().astype(jnp.int32),
+        ])
+        return cache, state, toks.T, valid.T, summary
     return cache, state, toks.T, valid.T
 
 
@@ -1276,6 +1291,7 @@ def verify_chunk_program(
     pool=None,
     block_table=None,
     use_pallas=None,
+    with_summary: bool = False,
 ):
     """Score a draft window for every slot in ONE target forward and
     commit the accepted prefix.
@@ -1307,7 +1323,9 @@ def verify_chunk_program(
     does not do); ``eos_id``/``min_new_tokens`` are supported.  Returns
     ``(cache, state, toks, valid)`` shaped exactly like
     :func:`decode_chunk_program` — the serving engine's emission
-    handling cannot tell the two apart.
+    handling cannot tell the two apart.  ``with_summary=True`` appends
+    the same device int32 ``[emitted_count, active_count]`` pair the
+    chunk program grows, for the pipelined scheduler's drain.
     """
     if sample.temperature != 0.0 or sample.repetition_penalty != 1.0:
         raise ValueError(
@@ -1428,6 +1446,12 @@ def verify_chunk_program(
         finished = finished | ((n > 0) & (last_tok == sample.eos_id))
     new_state["active"] = active & ~finished
     new_state["tok"] = jnp.where(n > 0, last_tok, state["tok"])
+    if with_summary:
+        summary = jnp.stack([
+            valid.sum().astype(jnp.int32),
+            new_state["active"].sum().astype(jnp.int32),
+        ])
+        return cache, new_state, toks, valid, summary
     return cache, new_state, toks, valid
 
 

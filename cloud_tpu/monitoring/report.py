@@ -133,6 +133,7 @@ class TraceReport:
     _SERVE_ORDER = (
         "serve/queue_wait", "serve/batch_form", "serve/prefill",
         "serve/decode", "serve/chunk", "serve/draft", "serve/verify",
+        "serve/host_bubble", "serve/dispatch_gap",
     )
 
     def continuous_summary(self) -> Optional[Dict[str, float]]:
@@ -172,6 +173,23 @@ class TraceReport:
             ),
             None,
         )
+        # Pipelined scheduling: the drain records the blocking host
+        # copy it actually paid as serve/host_bubble, so bubble time /
+        # chunk time is the fraction of the decode timeline the host
+        # still stalls the device for (None on depth-1 timelines,
+        # which record no bubble spans).
+        chunk_us = sum(
+            e.get("dur", 0.0) for e in self.events
+            if e.get("name") in ("serve/chunk", "serve/verify")
+        )
+        bubble_us = sum(
+            e.get("dur", 0.0) for e in self.events
+            if e.get("name") == "serve/host_bubble"
+        )
+        bubble_fraction = (
+            bubble_us / chunk_us
+            if bubble_us and chunk_us else None
+        )
         return {
             "chunks": len(chunks),
             "mean_occupancy": mean_of("occupancy"),
@@ -180,6 +198,7 @@ class TraceReport:
             "tokens": sum(tokens) if tokens else None,
             "slice": slice_shape,
             "slice_chips": slice_chips,
+            "bubble_fraction": bubble_fraction,
         }
 
     def prefix_summary(self) -> Optional[Dict[str, object]]:
@@ -1007,6 +1026,10 @@ class TraceReport:
                 parts.append(active)
             if continuous["tokens"] is not None:
                 parts.append(f"{continuous['tokens']:.0f} tokens")
+            if continuous.get("bubble_fraction") is not None:
+                parts.append(
+                    f"host bubble {continuous['bubble_fraction']:.1%}"
+                )
             lines.append("")
             lines.append("continuous batching: " + " · ".join(parts))
         spec = self.spec_summary()

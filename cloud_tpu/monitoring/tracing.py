@@ -425,11 +425,18 @@ def record_span(name: str, start: float, end: float,
     For phases whose start and end live on different threads — a serving
     request's queue wait begins at ``submit()`` on the caller's thread
     and ends when the scheduler folds it into a batch — where a context
-    manager cannot wrap the interval.  ``start``/``end`` are
-    ``time.perf_counter()`` readings; the span lands in the timeline,
-    aggregates, and the ``span/<name>`` metrics distribution exactly like
-    a context-manager span (no parent nesting, since no thread "owns"
-    it).  No-op while tracing is disabled, same as :func:`span`.
+    manager cannot wrap the interval, and for phases known only in
+    retrospect: the pipelined serving scheduler measures each chunk's
+    dispatch→drain interval (``serve/chunk``/``serve/verify``), the
+    blocking host copy actually paid at drain (``serve/host_bubble``),
+    and the gap between consecutive dispatches (``serve/dispatch_gap``)
+    this way, since at ``pipeline_depth=2`` no live context manager can
+    bracket work that completes one scheduler pass later.
+    ``start``/``end`` are ``time.perf_counter()`` readings; the span
+    lands in the timeline, aggregates, and the ``span/<name>`` metrics
+    distribution exactly like a context-manager span (no parent
+    nesting, since no thread "owns" it).  No-op while tracing is
+    disabled, same as :func:`span`.
     """
     collector = _collector
     if collector is None:

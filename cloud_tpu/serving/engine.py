@@ -123,6 +123,7 @@ import collections
 import contextlib
 import dataclasses
 import logging
+import os
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
@@ -350,6 +351,20 @@ class ServeConfig:
     #: advertising stale cached-prefix credit to the cost-model router.
     #: ``None`` (default) never expires — byte-identical to today.
     prefix_summary_ttl_s: Optional[float] = None
+    #: Scheduler pipelining depth.  ``1`` (default) is the strictly
+    #: synchronous loop — dispatch a chunk, block on its emissions,
+    #: mutate slots, dispatch the next — byte-identical to today.  ``2``
+    #: keeps a second chunk in flight: chunk N+1 is dispatched against
+    #: the device-resident slot state *before* chunk N's emissions are
+    #: synchronized, and N drains (non-blocking device→host copy) while
+    #: the device runs N+1, hiding the host scheduling bubble.  Slot
+    #: mutations from a drain apply to the *next* dispatch (one pass
+    #: stale); the chunk program's active mask keeps a speculatively
+    #: dispatched chunk for a just-finished slot emitting only masked
+    #: tokens, so greedy outputs are token-identical to depth 1
+    #: (docs/serving.md "Pipelined scheduling").  Kill switch:
+    #: ``CLOUD_TPU_PIPELINE=0`` forces depth 1 at engine build.
+    pipeline_depth: int = 1
 
     def __post_init__(self):
         from cloud_tpu.models.generation import SampleConfig
@@ -496,6 +511,18 @@ class ServeConfig:
                 "continuous scheduler — the block table pages slot rows "
                 "of the persistent grid; the batch path re-prefills a "
                 "fresh cache per batch"
+            )
+        if self.pipeline_depth not in (1, 2):
+            raise ValueError(
+                f"pipeline_depth must be 1 or 2, got "
+                f"{self.pipeline_depth!r}"
+            )
+        if self.pipeline_depth > 1 and self.scheduler != "continuous":
+            raise ValueError(
+                "pipeline_depth=2 (pipelined scheduling) needs the "
+                "continuous scheduler — the in-flight ring overlaps "
+                "chunk dispatches on the persistent slot grid; the "
+                "batch path has no standing state to dispatch against"
             )
         if self.layout not in ("explicit", "auto"):
             raise ValueError(
@@ -650,6 +677,45 @@ class _PrefillTask:
     #: The acquired prefix hit (its KV was copied in before the first
     #: chunk), or None on a cold prefill.
     hit: Optional[object] = None
+
+
+@dataclasses.dataclass
+class _InflightChunk:
+    """One dispatched-but-undrained chunk in the pipelined scheduler's
+    in-flight ring (``pipeline_depth=2``; scheduler-thread only).
+
+    Holds the *device-side* emission arrays exactly as the chunk
+    program returned them — the drain half materializes them with a
+    blocking host copy (``engine._to_host``) one pass later, after the
+    NEXT chunk has already been dispatched, so the host-side copy wait
+    overlaps device compute.  A slot occupying a row here is never in
+    ``_free_slots`` (retirement happens at drain), so an in-flight
+    chunk can never describe a slot that was re-assigned under it.
+    """
+
+    #: Device array of emitted token ids, ``[num_slots, width]``.
+    toks: object
+    #: Device bool array — which emissions are live, same shape.
+    valid: object
+    #: Device int32 ``[emitted_count, active_count]`` summary from the
+    #: chunk program (``with_summary=True``) — rides along so callers
+    #: that only need occupancy never block on the full emission grid.
+    summary: object
+    #: Emission width: ``chunk_tokens`` (decode) or ``spec_k`` (verify).
+    width: int
+    #: ``"chunk"`` or ``"verify"`` — picks the terminal span name and
+    #: the stats the drain updates.
+    kind: str
+    #: ``len(_active_slots)`` at dispatch (the verify drain's
+    #: accept-rate denominator).
+    active: int
+    #: Span attributes captured at dispatch (slots/chunk/active/slice/
+    #: traces) — the drain adds tokens/occupancy and records the span
+    #: over the full dispatch→drain interval.
+    span_attrs: dict
+    #: ``time.perf_counter()`` bracketing the dispatch call itself.
+    dispatch_start: float
+    dispatch_end: float
 
 
 class _Cell:
@@ -1017,6 +1083,26 @@ class ServingEngine:
             # update in place; CPU ignores donation with a warning, so
             # only ask for it where the backend honors it.
             self._donate = jax.default_backend() != "cpu"
+            #: Effective pipelining depth: the config's, unless the
+            #: CLOUD_TPU_PIPELINE=0 kill switch forces the synchronous
+            #: loop (same env idiom as CLOUD_TPU_TRACE).  Resolved once
+            #: at build — flipping the env mid-run does nothing.
+            self._pipe_depth = cfg.pipeline_depth
+            if os.environ.get("CLOUD_TPU_PIPELINE", "1") == "0":
+                self._pipe_depth = 1
+            #: Dispatched-but-undrained chunks, oldest first
+            #: (scheduler-thread only).  Empty at every pass boundary
+            #: at depth 1 — the synchronous loop never grows it, so
+            #: the default path stays byte-identical.
+            self._inflight: collections.deque = collections.deque()
+            #: Rolling dispatch→dispatch host gaps (ms) — the bubble
+            #: the pipeline exists to hide.  Tracked at every depth
+            #: (host-side bookkeeping only; no spans at depth 1) so
+            #: bench probes can compare p50/p99 across arms.
+            self._dispatch_gaps: collections.deque = collections.deque(
+                maxlen=512
+            )
+            self._last_chunk_dispatch_end: Optional[float] = None
             self._chunk_step = self._make_chunk_step()
             #: Speculative decoding (None unless ServeConfig.draft):
             #: the draft model's own slot cache + its program cells and
@@ -1289,6 +1375,7 @@ class ServingEngine:
             return generation.verify_chunk_program(
                 params, cache, state, window, self.config,
                 sample=cfg.sample, rules=self.rules, mesh=self.mesh,
+                with_summary=self._pipe_depth > 1,
                 **self._paged_kwargs(extra),
             )
 
@@ -1598,6 +1685,7 @@ class ServingEngine:
                 params, cache, state, self.config,
                 chunk_size=cfg.chunk_tokens, sample=cfg.sample, rng=rng,
                 rules=self.rules, mesh=self.mesh,
+                with_summary=self._pipe_depth > 1,
                 **self._paged_kwargs(extra),
             )
 
@@ -2417,6 +2505,7 @@ class ServingEngine:
                 self._fail_pending_locked(exc)
                 self._cond.notify_all()
             if self._continuous:
+                self._dispose_inflight()
                 self._fail_live_slots(exc)
 
     def _batch_loop(self) -> None:
@@ -2490,13 +2579,14 @@ class ServingEngine:
                         break
                     self._pop_inserts_locked(inserts)
                     if (inserts or self._active_slots
-                            or self._prefill_tasks):
+                            or self._prefill_tasks or self._inflight):
                         break
                     if self._closed:
                         return  # draining and nothing left to serve
                     self._cond.wait()
             if abort:
                 self._prefill_tasks.clear()
+                self._dispose_inflight()
                 self._fail_live_slots(EngineClosedError(
                     "engine closed without draining in-flight requests"
                 ))
@@ -2524,10 +2614,41 @@ class ServingEngine:
             if self._prefill_tasks:
                 self._advance_prefill()
             if self._active_slots:
-                if self._spec:
+                if self._pipe_depth > 1:
+                    # Survivor guard: the host knows every slot's budget,
+                    # so it can tell — without syncing — when the work
+                    # already in flight will exhaust ALL of them.  A
+                    # further dispatch would be pure dead rows (the
+                    # device active mask has already killed every slot);
+                    # skip it and let the drain below run the pass like
+                    # depth 1 instead.  Eos only ends a slot EARLIER
+                    # than the budget, so the guard can at worst allow
+                    # a partially-dead chunk — never block a live one.
+                    if self._predict_survivors():
+                        if self._spec:
+                            self._dispatch_spec_chunk_async()
+                        else:
+                            self._dispatch_chunk_async()
+                elif self._spec:
                     self._dispatch_spec_chunk()
                 else:
                     self._dispatch_chunk()
+            # Drain half of the pipelined pass (the ring is ALWAYS empty
+            # at depth 1 — the synchronous paths above never grow it, so
+            # this loop is a no-op and the default flow is unchanged).
+            # While any slot can outlive the work in flight, keep
+            # depth-1 chunks in the ring; once nothing can (wave end,
+            # idle engine), drain dry so every pass boundary — and a
+            # graceful close() — sees an empty ring with all emissions
+            # committed and futures settled.  The condition is
+            # re-evaluated per drain: a drain that retires the last
+            # active slot flips the target to zero and flushes the
+            # trailing speculative chunk (whose rows are all masked).
+            while len(self._inflight) > (
+                    self._pipe_depth - 1
+                    if self._active_slots and self._predict_survivors()
+                    else 0):
+                self._drain_inflight()
 
     def _pop_inserts_locked(self, inserts) -> None:
         """Claim one free slot per waiting request — oldest submit first
@@ -2817,6 +2938,16 @@ class ServingEngine:
         from cloud_tpu.serving.prefix_cache import SKIP_BLOCK, PrefixHit
 
         cfg = self.serve_config
+        if self._inflight:
+            # Pipelined scheduling: a chunk dispatched last pass is
+            # still in flight, so this save-back's pool writes land
+            # AFTER it on the device stream (dataflow through the
+            # donated grid cache orders them) — the trie entry created
+            # below is deferred in exactly that sense.  Counted so the
+            # parity tests can assert the ordering path was exercised
+            # (prefix_cache.py "Save-back ordering under pipelined
+            # scheduling").
+            self._prefix.note_deferred_save()
         if already is None:
             already = PrefixHit(nodes=(), tokens=0)
         with self._demote_burst():
@@ -3101,10 +3232,12 @@ class ServingEngine:
         traces = self._active_trace_map()
         if traces:
             span_attrs["traces"] = traces
+        self._note_dispatch_gap(time.perf_counter())
         with tracing.span("serve/chunk", **span_attrs) as chunk_span:
             self._grid_cache, self._slot_state, toks, valid = (
                 self._supervised("serve/chunk", dispatch)
             )
+            self._last_chunk_dispatch_end = time.perf_counter()
             toks, valid = self._to_host("chunk_tokens", toks, valid)
             emitted = int(valid.sum())
             occupancy = emitted / float(num_slots * chunk)
@@ -3171,6 +3304,7 @@ class ServingEngine:
         cfg = self.serve_config
         num_slots, k = cfg.num_slots, cfg.draft.spec_k
         active_n = len(self._active_slots)
+        self._note_dispatch_gap(time.perf_counter())
 
         def draft_dispatch():
             faults.fault_point("serve.draft")
@@ -3204,6 +3338,7 @@ class ServingEngine:
             self._grid_cache, self._slot_state, toks, valid = (
                 self._supervised("serve/verify", verify_dispatch)
             )
+            self._last_chunk_dispatch_end = time.perf_counter()
             toks, valid = self._to_host("verify_tokens", toks, valid)
             emitted = int(valid.sum())
             # Every active slot commits >= 1 token (the first-mismatch
@@ -3241,6 +3376,234 @@ class ServingEngine:
             accepted = sum(a for a, _ in self._accept_window)
             proposed = sum(p for _, p in self._accept_window)
         return accepted / proposed if proposed else 0.0
+
+    # -- pipelined scheduling (pipeline_depth=2) ---------------------------
+
+    def _note_dispatch_gap(self, start: float) -> None:
+        """Record the host gap between the previous chunk dispatch and
+        this one — the scheduling bubble the pipeline exists to hide.
+        Deque-only at depth 1 (the default path emits no new spans); at
+        depth 2 also recorded as a ``serve/dispatch_gap`` span so the
+        report's serve breakdown can attribute the residual bubble."""
+        last = self._last_chunk_dispatch_end
+        if last is None:
+            return
+        with self._stats_lock:
+            # Under the lock: health()/stats() snapshot the deque from
+            # router threads while the scheduler appends.
+            self._dispatch_gaps.append((start - last) * 1000.0)
+        if self._pipe_depth > 1:
+            tracing.record_span("serve/dispatch_gap", last, start)
+
+    def _predict_survivors(self) -> bool:
+        """Host-side liveness prediction, no device sync: can ANY
+        active slot still be decoding after every chunk already in the
+        in-flight ring lands?
+
+        The host knows each slot's budget exactly (``max_new_tokens``
+        minus tokens committed so far) and each ring entry's maximum
+        per-slot progress (its emission ``width``), so budget
+        exhaustion is predictable at dispatch time.  Eos is not — but
+        eos only retires a slot EARLIER than its budget, so a ``True``
+        here can at worst admit a partially-dead chunk (the device
+        active mask zeroes those rows, exactly as at depth 1), never
+        suppress a live one.  Used by the pipelined pass to stop
+        dispatching ahead once the work in flight provably finishes
+        every slot — the all-dead trailing chunk a naive
+        dispatch-ahead loop would waste at each wave end."""
+        pending = sum(rec.width for rec in self._inflight)
+        for slot in self._active_slots:
+            entry = self._slot_table[slot]
+            if entry is None:  # pragma: no cover - retire races
+                continue
+            if entry.request.max_new_tokens - len(entry.tokens) > pending:
+                return True
+        return False
+
+    def _start_host_copy(self, *arrays) -> None:
+        """Kick off non-blocking device→host copies for a dispatched
+        chunk's emission buffers, so the drain's blocking ``_to_host``
+        one pass later finds the bytes already (or nearly) resident.
+        Best effort: backends/array types without the method simply
+        fall back to the blocking copy at drain."""
+        for arr in arrays:
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                return
+
+    def _dispatch_chunk_async(self) -> None:
+        """Dispatch half of the pipelined decode pass: enqueue one
+        chunk against the current device-resident grid and push its
+        *unmaterialized* emission arrays onto the in-flight ring — no
+        host sync here.  ``_drain_inflight`` commits them one pass
+        later, after the NEXT chunk is already running, so the commit/
+        retire/insert host work overlaps device compute.  Metrics and
+        stats move to the drain with the emissions: a disposed (never
+        drained) chunk is never counted."""
+        import jax
+
+        cfg = self.serve_config
+        num_slots, chunk = cfg.num_slots, cfg.chunk_tokens
+        self._rng, chunk_rng = jax.random.split(self._rng)
+
+        def dispatch():
+            faults.fault_point("serve.chunk")
+            return self._chunk_step(
+                self.params, self._grid_cache, self._slot_state, chunk_rng,
+                *self._paged_extra(),
+            )
+
+        span_attrs = dict(
+            slots=num_slots, chunk=chunk, active=len(self._active_slots),
+        )
+        if self._slice_chips > 1:
+            span_attrs["slice"] = (
+                f"{self._slice_shape[0]}x{self._slice_shape[1]}"
+            )
+            span_attrs["slice_chips"] = self._slice_chips
+        traces = self._active_trace_map()
+        if traces:
+            span_attrs["traces"] = traces
+        start = time.perf_counter()
+        self._note_dispatch_gap(start)
+        self._grid_cache, self._slot_state, toks, valid, summary = (
+            self._supervised("serve/chunk", dispatch)
+        )
+        end = time.perf_counter()
+        self._last_chunk_dispatch_end = end
+        self._start_host_copy(toks, valid, summary)
+        self._inflight.append(_InflightChunk(
+            toks=toks, valid=valid, summary=summary, width=chunk,
+            kind="chunk", active=len(self._active_slots),
+            span_attrs=span_attrs, dispatch_start=start, dispatch_end=end,
+        ))
+
+    def _dispatch_spec_chunk_async(self) -> None:
+        """Pipelined draft-and-verify round: both dispatches enqueue
+        back to back (the verify consumes the draft's window as a
+        device operand — no host sync between them) and the verify's
+        emissions ride the in-flight ring exactly like a decode
+        chunk's.  The ``serve/draft`` span brackets only the enqueue
+        here; the ``serve/verify`` span is recorded at drain over the
+        full dispatch→drain interval."""
+        cfg = self.serve_config
+        num_slots, k = cfg.num_slots, cfg.draft.spec_k
+        active_n = len(self._active_slots)
+
+        def draft_dispatch():
+            faults.fault_point("serve.draft")
+            return self._draft_step(
+                self._draft_params, self._draft_cache, self._slot_state
+            )
+
+        start = time.perf_counter()
+        self._note_dispatch_gap(start)
+        with tracing.span("serve/draft", slots=num_slots, spec_k=k,
+                          active=active_n):
+            self._draft_cache, window = self._supervised(
+                "serve/draft", draft_dispatch
+            )
+
+        def verify_dispatch():
+            faults.fault_point("serve.verify")
+            return self._verify_step(
+                self.params, self._grid_cache, self._slot_state, window,
+                *self._paged_extra(),
+            )
+
+        span_attrs = dict(slots=num_slots, spec_k=k, active=active_n)
+        if self._slice_chips > 1:
+            span_attrs["slice"] = (
+                f"{self._slice_shape[0]}x{self._slice_shape[1]}"
+            )
+            span_attrs["slice_chips"] = self._slice_chips
+        traces = self._active_trace_map()
+        if traces:
+            span_attrs["traces"] = traces
+        self._grid_cache, self._slot_state, toks, valid, summary = (
+            self._supervised("serve/verify", verify_dispatch)
+        )
+        end = time.perf_counter()
+        self._last_chunk_dispatch_end = end
+        self._start_host_copy(toks, valid, summary)
+        self._inflight.append(_InflightChunk(
+            toks=toks, valid=valid, summary=summary, width=k,
+            kind="verify", active=active_n,
+            span_attrs=span_attrs, dispatch_start=start, dispatch_end=end,
+        ))
+
+    def _drain_inflight(self) -> None:
+        """Drain half of the pipelined pass: materialize the OLDEST
+        in-flight chunk's emissions (the blocking host copy overlaps
+        the device running the chunk dispatched after it — the wait
+        actually paid is recorded as ``serve/host_bubble``), then run
+        the exact metrics/stats/commit sequence of the synchronous
+        path.  The terminal ``serve/chunk``/``serve/verify`` span
+        covers dispatch→drain, so the report's serve breakdown keeps
+        aggregating occupancy the same way at any depth."""
+        rec = self._inflight.popleft()
+        cfg = self.serve_config
+        num_slots = cfg.num_slots
+        wait0 = time.perf_counter()
+        toks, valid, summary = self._to_host(
+            f"{rec.kind}_tokens", rec.toks, rec.valid, rec.summary
+        )
+        wait1 = time.perf_counter()
+        tracing.record_span("serve/host_bubble", wait0, wait1,
+                            kind=rec.kind, width=rec.width)
+        emitted = int(summary[0])
+        occupancy = emitted / float(num_slots * rec.width)
+        attrs = dict(rec.span_attrs)
+        attrs["tokens"] = emitted
+        attrs["occupancy"] = round(occupancy, 4)
+        if rec.kind == "verify":
+            accepted = max(emitted - rec.active, 0)
+            proposed = rec.active * (cfg.draft.spec_k - 1)
+            attrs["accepted"] = accepted
+            attrs["proposed"] = proposed
+            tracing.record_span("serve/verify", rec.dispatch_start,
+                                wait1, **attrs)
+            metrics.counter_inc("serve/spec_chunks")
+            metrics.counter_inc("serve/spec_accepted_tokens", accepted)
+            metrics.gauge_set("serve/slot_occupancy", occupancy)
+            with self._stats_lock:
+                self._accept_window.append((accepted, proposed))
+                self._stats["spec_chunks"] += 1
+                self._stats["spec_emitted"] += emitted
+                self._stats["spec_accepted"] += accepted
+                self._stats["spec_proposed"] += proposed
+                self._stats["decode_slot_steps"] += num_slots * rec.width
+                self._stats["useful_decode_tokens"] += emitted
+            metrics.gauge_set(
+                "serve/spec_accept_rate", self._rolling_acceptance()
+            )
+        else:
+            tracing.record_span("serve/chunk", rec.dispatch_start,
+                                wait1, **attrs)
+            metrics.counter_inc("serve/chunks")
+            metrics.gauge_set("serve/slot_occupancy", occupancy)
+            with self._stats_lock:
+                self._stats["chunks"] += 1
+                self._stats["decode_slot_steps"] += num_slots * rec.width
+                self._stats["useful_decode_tokens"] += emitted
+        self._commit_emissions(toks, valid, rec.width)
+
+    def _dispose_inflight(self) -> None:
+        """Abandon the in-flight ring without committing (abort/crash
+        paths): block until every pending dispatch and its async
+        device→host copy actually completed — ``close(drain=False)``
+        must never leave a computation or copy running against state
+        being torn down — then drop the results.  Errors are logged,
+        not raised: disposal must not mask the failure that got us
+        here, and the slots' futures are failed by the caller."""
+        while self._inflight:
+            rec = self._inflight.popleft()
+            try:
+                self._to_host(f"{rec.kind}_dispose", rec.toks, rec.valid,
+                              rec.summary)
+            except Exception:  # noqa: BLE001
+                logger.exception("disposing in-flight chunk failed")
 
     def _dispatch_draft_prefill(self, request: _Request, slot: int) -> None:
         """Mirror a just-armed slot's prompt into the draft model's
@@ -3547,6 +3910,16 @@ class ServingEngine:
             # with roles off): the role the fleet router steers legs
             # by, plus the KV handoff counters.
             "role": self._role,
+            # Pipelined scheduling (stable schema — depth 1 / 0.0 on
+            # the batch scheduler and before the first two chunks):
+            # the effective depth and the rolling mean host gap
+            # between consecutive chunk dispatches, the bubble depth 2
+            # exists to hide — a supervisor alert on it regressing is
+            # the cheapest "pipelining stopped helping" signal.
+            "pipeline_depth": (
+                self._pipe_depth if self._continuous else 1
+            ),
+            "dispatch_gap_ms": self._dispatch_gap_mean(),
         }
         with self._stats_lock:
             snap["handoff_exports"] = self._stats["handoff_exports"]
@@ -3605,6 +3978,11 @@ class ServingEngine:
             "prefix_dram_swapin_failures": (
                 prefix["swapin_failures"] if prefix else 0
             ),
+            # Pipelined save-backs (0 at pipeline_depth=1): the parity
+            # tests assert the deferred-ordering path was exercised.
+            "prefix_deferred_saves": (
+                prefix["deferred_saves"] if prefix else 0
+            ),
             "cached_prefixes": (
                 self._prefix.hot_prefixes()
                 if self._continuous and self._prefix is not None else {}
@@ -3646,8 +4024,36 @@ class ServingEngine:
             snap["spec_accepted"] / snap["spec_proposed"]
             if snap["spec_proposed"] else 0.0
         )
+        # Pipelined scheduling (stable schema — depth 1 / 0.0 on the
+        # batch scheduler): dispatch-gap percentiles over the rolling
+        # window, the per-arm numbers the serving_pipeline bench probe
+        # reports.
+        snap["pipeline_depth"] = (
+            self._pipe_depth if self._continuous else 1
+        )
+        gaps = self._dispatch_gap_window()
+        snap["dispatch_gap_ms_p50"] = (
+            float(np.percentile(gaps, 50)) if gaps else 0.0
+        )
+        snap["dispatch_gap_ms_p99"] = (
+            float(np.percentile(gaps, 99)) if gaps else 0.0
+        )
         snap.update(self._prefix_snapshot())
         return snap
+
+    def _dispatch_gap_window(self) -> List[float]:
+        """Snapshot of the rolling dispatch-gap window (ms), empty on
+        the batch scheduler and before the first two chunk dispatches."""
+        if not self._continuous:
+            return []
+        with self._stats_lock:
+            return list(self._dispatch_gaps)
+
+    def _dispatch_gap_mean(self) -> float:
+        """health()'s rolling mean dispatch gap in ms (0.0 when the
+        window is empty)."""
+        gaps = self._dispatch_gap_window()
+        return float(sum(gaps) / len(gaps)) if gaps else 0.0
 
     @property
     def chunk_traces(self) -> int:

@@ -194,7 +194,35 @@ class PrefixCacheManager:
             # DRAM-tier counters (all stay 0 with dram_blocks == 0).
             "demotions": 0, "promotions": 0, "dram_evictions": 0,
             "dram_hits": 0, "dram_hit_tokens": 0, "swapin_failures": 0,
+            # Save-backs inserted while a pipelined chunk was still in
+            # flight (stays 0 at pipeline_depth=1) — see "Save-back
+            # ordering under pipelined scheduling" below.
+            "deferred_saves": 0,
         }
+
+    def note_deferred_save(self) -> None:
+        """Count one save-back that landed while a pipelined chunk was
+        still in flight (engine calls this from its scheduler thread;
+        the counter is the parity tests' evidence that the deferred
+        ordering path actually ran).
+
+        Save-back ordering under pipelined scheduling: with
+        ``pipeline_depth=2`` the engine inserts a prompt's trie entry —
+        and dispatches the pool write for its new blocks — while the
+        PREVIOUS decode chunk is still executing on the device.  Two
+        facts keep that safe with zero extra synchronization.  On the
+        device, the save program consumes the same donated grid cache
+        the in-flight chunk produces, so XLA's dataflow ordering runs
+        the pool write strictly AFTER the chunk — the saved rows are
+        exactly the post-prefill rows, never a torn snapshot.  On the
+        host, the trie entry becomes matchable the moment ``insert``
+        returns, but the only thread that can act on a match is the
+        scheduler thread itself (match/acquire/copy-in all happen
+        there), which by construction has already moved past the save —
+        so no request can attach a block whose pool write hasn't been
+        enqueued behind everything that could disturb it."""
+        with self._lock:
+            self._stats["deferred_saves"] += 1
 
     # -- introspection -----------------------------------------------------
 
